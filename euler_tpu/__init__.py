@@ -1,0 +1,15 @@
+"""euler_tpu: a TPU-native graph learning framework.
+
+A ground-up rebuild of the capabilities of Alibaba Euler 1.x
+(/root/reference) for TPU: a C++ host graph engine (weighted sampling,
+random walks, feature gather over an immutable SoA store) feeding JAX/XLA
+model compute through an async prefetch pipeline, with data-parallel
+training over a jax.sharding.Mesh instead of parameter servers.
+"""
+
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.convert import convert, convert_dicts
+
+__version__ = "0.1.0"
+
+__all__ = ["Graph", "convert", "convert_dicts"]

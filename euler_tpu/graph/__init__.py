@@ -1,0 +1,4 @@
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.convert import convert, convert_dicts
+
+__all__ = ["Graph", "convert", "convert_dicts"]

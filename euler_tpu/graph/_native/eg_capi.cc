@@ -1,0 +1,235 @@
+// C ABI for the euler_tpu graph engine, consumed from Python via ctypes.
+//
+// Role equivalent to the reference's ctypes surface
+// (reference tf_euler/utils/create_graph.cc:47 CreateGraph and
+// euler/service/python_api.cc StartService), generalized to a handle-based
+// batch API: fixed-shape calls write into caller-allocated numpy buffers;
+// variable-shape calls return an EGResult handle the caller drains and frees.
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "eg_engine.h"
+
+using eg::EGResult;
+using eg::Engine;
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+extern "C" {
+
+const char* eg_last_error() { return g_last_error.c_str(); }
+
+void* eg_create() { return new Engine(); }
+
+void eg_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+int eg_load(void* h, const char* dir, int shard_idx, int shard_num) {
+  auto* e = static_cast<Engine*>(h);
+  if (!e->Load(dir, shard_idx, shard_num)) {
+    g_last_error = e->error();
+    return -1;
+  }
+  return 0;
+}
+
+int eg_load_files(void* h, const char** files, int nfiles) {
+  auto* e = static_cast<Engine*>(h);
+  std::vector<std::string> fs(files, files + nfiles);
+  if (!e->LoadFiles(std::move(fs))) {
+    g_last_error = e->error();
+    return -1;
+  }
+  return 0;
+}
+
+void eg_seed(uint64_t seed) { eg::SeedThreadRng(seed); }
+
+// ---- introspection ----
+int64_t eg_num_nodes(void* h) {
+  return static_cast<int64_t>(static_cast<Engine*>(h)->store().num_nodes());
+}
+int64_t eg_num_edges(void* h) {
+  return static_cast<int64_t>(static_cast<Engine*>(h)->store().num_edges());
+}
+int32_t eg_node_type_num(void* h) {
+  return static_cast<Engine*>(h)->store().node_type_num();
+}
+int32_t eg_edge_type_num(void* h) {
+  return static_cast<Engine*>(h)->store().edge_type_num();
+}
+// kind: 0=node u64, 1=node f32, 2=node binary, 3=edge u64, 4=edge f32,
+// 5=edge binary.
+int32_t eg_feature_num(void* h, int kind) {
+  const auto& s = static_cast<Engine*>(h)->store();
+  switch (kind) {
+    case 0: return s.nf_u64_num();
+    case 1: return s.nf_f32_num();
+    case 2: return s.nf_bin_num();
+    case 3: return s.ef_u64_num();
+    case 4: return s.ef_f32_num();
+    case 5: return s.ef_bin_num();
+    default: return -1;
+  }
+}
+// Per-type weight sums for cross-shard weighted sampling; out has
+// node_type_num (kind 0) or edge_type_num (kind 1) floats.
+void eg_type_weight_sums(void* h, int kind, float* out) {
+  const auto& s = static_cast<Engine*>(h)->store();
+  const auto& v =
+      kind == 0 ? s.node_type_weight_sums() : s.edge_type_weight_sums();
+  std::memcpy(out, v.data(), v.size() * sizeof(float));
+}
+
+// ---- sampling ----
+void eg_sample_node(void* h, int count, int32_t type, uint64_t* out) {
+  static_cast<Engine*>(h)->SampleNode(count, type, out);
+}
+
+void eg_sample_edge(void* h, int count, int32_t type, uint64_t* out_src,
+                    uint64_t* out_dst, int32_t* out_type) {
+  static_cast<Engine*>(h)->SampleEdge(count, type, out_src, out_dst, out_type);
+}
+
+void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
+                             uint64_t* out) {
+  static_cast<Engine*>(h)->SampleNodeWithSrc(src, n, count, out);
+}
+
+void eg_get_node_type(void* h, const uint64_t* ids, int n, int32_t* out) {
+  static_cast<Engine*>(h)->GetNodeType(ids, n, out);
+}
+
+void eg_sample_neighbor(void* h, const uint64_t* ids, int n,
+                        const int32_t* etypes, int net, int count,
+                        uint64_t default_id, uint64_t* out_ids, float* out_w,
+                        int32_t* out_t) {
+  static_cast<Engine*>(h)->SampleNeighbor(ids, n, etypes, net, count,
+                                          default_id, out_ids, out_w, out_t);
+}
+
+// etypes_flat: concatenated per-hop edge-type lists; etype_counts[h] =
+// number of edge types for hop h; counts[h] = fanout of hop h.
+// out_*: per-hop caller buffers, hop h sized n * prod(counts[:h+1]).
+void eg_sample_fanout(void* h, const uint64_t* ids, int n,
+                      const int32_t* etypes_flat, const int32_t* etype_counts,
+                      const int32_t* counts, int nhops, uint64_t default_id,
+                      uint64_t** out_ids, float** out_w, int32_t** out_t) {
+  static_cast<Engine*>(h)->SampleFanout(ids, n, etypes_flat, etype_counts,
+                                        counts, nhops, default_id, out_ids,
+                                        out_w, out_t);
+}
+
+void* eg_get_full_neighbor(void* h, const uint64_t* ids, int n,
+                           const int32_t* etypes, int net, int sorted) {
+  return static_cast<Engine*>(h)->GetFullNeighbor(ids, n, etypes, net,
+                                                  sorted != 0);
+}
+
+void eg_get_top_k_neighbor(void* h, const uint64_t* ids, int n,
+                           const int32_t* etypes, int net, int k,
+                           uint64_t default_id, uint64_t* out_ids,
+                           float* out_w, int32_t* out_t) {
+  static_cast<Engine*>(h)->GetTopKNeighbor(ids, n, etypes, net, k, default_id,
+                                           out_ids, out_w, out_t);
+}
+
+void eg_random_walk(void* h, const uint64_t* ids, int n,
+                    const int32_t* etypes, int net, int walk_len, float p,
+                    float q, uint64_t default_id, uint64_t* out) {
+  static_cast<Engine*>(h)->RandomWalk(ids, n, etypes, net, nullptr, 0,
+                                      walk_len, p, q, default_id, out);
+}
+
+// ---- features ----
+void eg_get_dense_feature(void* h, const uint64_t* ids, int n,
+                          const int32_t* fids, const int32_t* dims, int nf,
+                          float* out) {
+  static_cast<Engine*>(h)->GetDenseFeature(ids, n, fids, dims, nf, out);
+}
+
+void eg_get_edge_dense_feature(void* h, const uint64_t* src,
+                               const uint64_t* dst, const int32_t* types,
+                               int n, const int32_t* fids,
+                               const int32_t* dims, int nf, float* out) {
+  static_cast<Engine*>(h)->GetEdgeDenseFeature(src, dst, types, n, fids, dims,
+                                               nf, out);
+}
+
+void* eg_get_sparse_feature(void* h, const uint64_t* ids, int n,
+                            const int32_t* fids, int nf) {
+  return static_cast<Engine*>(h)->GetSparseFeature(ids, n, fids, nf);
+}
+
+void* eg_get_edge_sparse_feature(void* h, const uint64_t* src,
+                                 const uint64_t* dst, const int32_t* types,
+                                 int n, const int32_t* fids, int nf) {
+  return static_cast<Engine*>(h)->GetEdgeSparseFeature(src, dst, types, n,
+                                                       fids, nf);
+}
+
+void* eg_get_binary_feature(void* h, const uint64_t* ids, int n,
+                            const int32_t* fids, int nf) {
+  return static_cast<Engine*>(h)->GetBinaryFeature(ids, n, fids, nf);
+}
+
+void* eg_get_edge_binary_feature(void* h, const uint64_t* src,
+                                 const uint64_t* dst, const int32_t* types,
+                                 int n, const int32_t* fids, int nf) {
+  return static_cast<Engine*>(h)->GetEdgeBinaryFeature(src, dst, types, n,
+                                                       fids, nf);
+}
+
+// ---- result access ----
+// kind: 0=u64, 1=f32, 2=i32, 3=bytes; slot indexes within that kind.
+int64_t eg_result_size(void* r, int kind, int slot) {
+  auto* res = static_cast<EGResult*>(r);
+  switch (kind) {
+    case 0:
+      return slot < static_cast<int>(res->u64.size())
+                 ? static_cast<int64_t>(res->u64[slot].size())
+                 : -1;
+    case 1:
+      return slot < static_cast<int>(res->f32.size())
+                 ? static_cast<int64_t>(res->f32[slot].size())
+                 : -1;
+    case 2:
+      return slot < static_cast<int>(res->i32.size())
+                 ? static_cast<int64_t>(res->i32[slot].size())
+                 : -1;
+    case 3:
+      return slot < static_cast<int>(res->bytes.size())
+                 ? static_cast<int64_t>(res->bytes[slot].size())
+                 : -1;
+    default:
+      return -1;
+  }
+}
+
+void eg_result_copy(void* r, int kind, int slot, void* out) {
+  auto* res = static_cast<EGResult*>(r);
+  switch (kind) {
+    case 0:
+      std::memcpy(out, res->u64[slot].data(),
+                  res->u64[slot].size() * sizeof(uint64_t));
+      break;
+    case 1:
+      std::memcpy(out, res->f32[slot].data(),
+                  res->f32[slot].size() * sizeof(float));
+      break;
+    case 2:
+      std::memcpy(out, res->i32[slot].data(),
+                  res->i32[slot].size() * sizeof(int32_t));
+      break;
+    case 3:
+      std::memcpy(out, res->bytes[slot].data(), res->bytes[slot].size());
+      break;
+  }
+}
+
+void eg_result_free(void* r) { delete static_cast<EGResult*>(r); }
+
+}  // extern "C"

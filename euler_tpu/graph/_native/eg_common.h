@@ -1,0 +1,115 @@
+// euler_tpu native graph engine — common types and utilities.
+//
+// TPU-native rebuild of the reference Euler graph engine
+// (cf. /root/reference/euler/common/data_types.h, random.h, bytes_reader.h).
+// Design departs from the reference: the store is a flat SoA arena (see
+// eg_graph.h) rather than per-node heap objects, so batch sampling is
+// cache-friendly and trivially parallel across a host CPU feeding TPU chips.
+#ifndef EG_COMMON_H_
+#define EG_COMMON_H_
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace eg {
+
+using NodeID = uint64_t;
+
+// Edge identity: (src, dst, type). Mirrors the reference wire semantics
+// (reference euler/common/data_types.h:29-41) with our own hash mix.
+struct EdgeKey {
+  uint64_t src;
+  uint64_t dst;
+  int32_t type;
+  bool operator==(const EdgeKey& o) const {
+    return src == o.src && dst == o.dst && type == o.type;
+  }
+};
+
+struct EdgeKeyHash {
+  size_t operator()(const EdgeKey& k) const {
+    // splitmix64-style mixing of the three fields.
+    uint64_t h = k.src * 0x9E3779B97F4A7C15ULL;
+    h ^= (k.dst + 0x9E3779B97F4A7C15ULL + (h << 6) + (h >> 2));
+    h *= 0xBF58476D1CE4E5B9ULL;
+    h ^= static_cast<uint64_t>(k.type) + (h >> 31);
+    return static_cast<size_t>(h * 0x94D049BB133111EBULL);
+  }
+};
+
+// Fast per-thread RNG (xorshift-based splitmix64). The reference uses
+// thread_local std::default_random_engine (reference euler/common/random.cc:22);
+// we need something cheaper because sampling draws dominate the host profile.
+struct Rng {
+  uint64_t state;
+  explicit Rng(uint64_t seed = 0x853C49E6748FEA9BULL) : state(seed) {}
+  inline uint64_t Next() {
+    uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  // Uniform in [0, 1).
+  inline double NextDouble() {
+    return static_cast<double>(Next() >> 11) * (1.0 / 9007199254740992.0);
+  }
+  inline float NextFloat() { return static_cast<float>(NextDouble()); }
+  // Uniform integer in [0, n).
+  inline uint64_t NextLess(uint64_t n) {
+    return n ? static_cast<uint64_t>(NextDouble() * static_cast<double>(n)) % n
+             : 0;
+  }
+};
+
+Rng& ThreadRng();
+void SeedThreadRng(uint64_t seed);
+
+// Little-endian cursor over a byte buffer; unaligned-safe via memcpy.
+// (Equivalent role to reference euler/common/bytes_reader.h:27.)
+class ByteCursor {
+ public:
+  ByteCursor(const char* data, size_t size) : p_(data), end_(data + size) {}
+
+  template <typename T>
+  bool Read(T* out) {
+    if (p_ + sizeof(T) > end_) return false;
+    std::memcpy(out, p_, sizeof(T));
+    p_ += sizeof(T);
+    return true;
+  }
+
+  template <typename T>
+  bool ReadVec(size_t n, std::vector<T>* out) {
+    if (p_ + n * sizeof(T) > end_) return false;
+    out->resize(n);
+    if (n) std::memcpy(out->data(), p_, n * sizeof(T));
+    p_ += n * sizeof(T);
+    return true;
+  }
+
+  bool ReadStr(size_t n, std::string* out) {
+    if (p_ + n > end_) return false;
+    out->assign(p_, n);
+    p_ += n;
+    return true;
+  }
+
+  bool Skip(size_t n) {
+    if (p_ + n > end_) return false;
+    p_ += n;
+    return true;
+  }
+
+  size_t remaining() const { return static_cast<size_t>(end_ - p_); }
+  const char* ptr() const { return p_; }
+
+ private:
+  const char* p_;
+  const char* end_;
+};
+
+}  // namespace eg
+
+#endif  // EG_COMMON_H_

@@ -1,0 +1,109 @@
+// Batch engine over the graph store: the host-side query surface that feeds
+// the TPU input pipeline.
+//
+// Functional equivalent of the reference GraphEngine
+// (reference euler/core/graph_engine.h:33) plus parts of the local client
+// (reference euler/client/local_graph.cc) — but batch-synchronous instead of
+// callback-async: the Python side drives it from a prefetch thread pool that
+// overlaps sampling with TPU compute, so the async completion machinery of
+// the reference (AsyncOpKernel + callbacks) is unnecessary. Batch ops are
+// parallelized with OpenMP over rows.
+#ifndef EG_ENGINE_H_
+#define EG_ENGINE_H_
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "eg_graph.h"
+
+namespace eg {
+
+// Variable-shaped result container crossing the C ABI (fixed-shape calls
+// write straight into caller-allocated numpy buffers instead).
+struct EGResult {
+  std::vector<std::vector<uint64_t>> u64;
+  std::vector<std::vector<float>> f32;
+  std::vector<std::vector<int32_t>> i32;
+  std::vector<std::string> bytes;
+};
+
+class Engine {
+ public:
+  // Load shard `shard_idx` of `shard_num` from a directory of partition
+  // files named *_<p>.dat: the shard owns partitions p ≡ shard_idx (mod
+  // shard_num) (reference euler/core/graph_engine.cc:90-107). Files without
+  // a partition suffix belong to partition 0.
+  bool Load(const std::string& dir, int shard_idx, int shard_num);
+  bool LoadFiles(std::vector<std::string> files);
+  const std::string& error() const { return error_; }
+
+  const GraphStore& store() const { return store_; }
+
+  // ---- global sampling ----
+  void SampleNode(int count, int32_t type, uint64_t* out) const;
+  void SampleEdge(int count, int32_t type, uint64_t* out_src,
+                  uint64_t* out_dst, int32_t* out_type) const;
+  // Typed negative sampling: for each src row, `count` nodes drawn from the
+  // global sampler of that src's node type. Replaces the reference's
+  // unique/while_loop/inflate_idx pipeline
+  // (reference tf_euler/python/euler_ops/sample_ops.py:39-67) with one
+  // host-side batch call producing a fixed [n, count] block.
+  void SampleNodeWithSrc(const uint64_t* src, int n, int count,
+                         uint64_t* out) const;
+
+  void GetNodeType(const uint64_t* ids, int n, int32_t* out) const;
+
+  // ---- neighbor ops ----
+  void SampleNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
+                      int net, int count, uint64_t default_id,
+                      uint64_t* out_ids, float* out_w, int32_t* out_t) const;
+  // Fused multi-hop fanout: one call produces every hop, avoiding the
+  // per-hop op round trips of the reference
+  // (reference tf_euler/python/euler_ops/neighbor_ops.py:86-92).
+  // hop h input size n_h = n * prod(counts[:h]); outputs are caller
+  // buffers of size n_{h+1} per hop.
+  void SampleFanout(const uint64_t* ids, int n, const int32_t* etypes_flat,
+                    const int32_t* etype_counts, const int32_t* counts,
+                    int nhops, uint64_t default_id, uint64_t** out_ids,
+                    float** out_w, int32_t** out_t) const;
+
+  EGResult* GetFullNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
+                            int net, bool sorted) const;
+  void GetTopKNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
+                       int net, int k, uint64_t default_id, uint64_t* out_ids,
+                       float* out_w, int32_t* out_t) const;
+
+  // ---- walks ----
+  // out: [n, walk_len+1], column 0 = start ids. Walks through missing nodes
+  // emit default_id for the rest of the walk.
+  void RandomWalk(const uint64_t* ids, int n, const int32_t* etypes, int net,
+                  const int32_t* parent_etypes, int pnet, int walk_len,
+                  float p, float q, uint64_t default_id, uint64_t* out) const;
+
+  // ---- features ----
+  void GetDenseFeature(const uint64_t* ids, int n, const int32_t* fids,
+                       const int32_t* dims, int nf, float* out) const;
+  void GetEdgeDenseFeature(const uint64_t* src, const uint64_t* dst,
+                           const int32_t* types, int n, const int32_t* fids,
+                           const int32_t* dims, int nf, float* out) const;
+  EGResult* GetSparseFeature(const uint64_t* ids, int n, const int32_t* fids,
+                             int nf) const;
+  EGResult* GetEdgeSparseFeature(const uint64_t* src, const uint64_t* dst,
+                                 const int32_t* types, int n,
+                                 const int32_t* fids, int nf) const;
+  EGResult* GetBinaryFeature(const uint64_t* ids, int n, const int32_t* fids,
+                             int nf) const;
+  EGResult* GetEdgeBinaryFeature(const uint64_t* src, const uint64_t* dst,
+                                 const int32_t* types, int n,
+                                 const int32_t* fids, int nf) const;
+
+ private:
+  GraphStore store_;
+  std::string error_;
+};
+
+}  // namespace eg
+
+#endif  // EG_ENGINE_H_

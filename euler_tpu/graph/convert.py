@@ -1,0 +1,190 @@
+"""JSON graph → binary .dat partition converter.
+
+Produces the same length-prefixed block format as the reference tooling
+(format spec derived from /root/reference/euler/tools/json2dat.py:40-175 and
+the Java partitioned converter tools/graph_data_parser/GraphDataParser.java:85),
+so fixtures and datasets interoperate in both directions. Partitioning follows
+the reference convention: node_id % num_partitions -> ``<prefix>_<p>.dat``.
+
+Input: one JSON object per line::
+
+    {"node_id": 1, "node_type": 0, "node_weight": 1.0,
+     "neighbor": {"0": {"2": 1.0}},          # edge_type -> {dst: weight}
+     "uint64_feature": {"0": [1, 2]},        # slot -> values
+     "float_feature": {"0": [0.5]},
+     "binary_feature": {"0": "ab"},
+     "edge": [{"src_id": 1, "dst_id": 2, "edge_type": 0, "weight": 1.0,
+               "uint64_feature": {}, "float_feature": {},
+               "binary_feature": {}}]}
+
+plus a meta.json declaring type/slot counts (node_type_num, edge_type_num,
+node_uint64_feature_num, node_float_feature_num, node_binary_feature_num and
+the three edge_* equivalents).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import IO
+
+
+def _pack_features(record: dict, slot_nums: dict[str, int]) -> bytes:
+    """Pack the u64/f32/binary feature sections shared by node and edge
+    records: for each kind, ``i32 slot_num, i32 sizes[slot_num], values``."""
+    out = []
+    for kind, fmt_char in (("uint64", "Q"), ("float", "f"), ("binary", "s")):
+        nslots = slot_nums[kind]
+        slots = record.get(kind + "_feature", {}) or {}
+        sizes = []
+        values = []
+        for i in range(nslots):
+            v = slots.get(str(i), [] if kind != "binary" else "")
+            if kind == "binary":
+                b = v.encode() if isinstance(v, str) else bytes(v)
+                sizes.append(len(b))
+                values.append(b)
+            else:
+                sizes.append(len(v))
+                values.extend(v)
+        out.append(struct.pack("<i%di" % nslots, nslots, *sizes))
+        if kind == "binary":
+            out.append(b"".join(values))
+        else:
+            out.append(struct.pack("<%d%s" % (len(values), fmt_char), *values))
+    return b"".join(out)
+
+
+def _pack_edge(edge: dict, meta: dict) -> bytes:
+    slot_nums = {
+        "uint64": int(meta["edge_uint64_feature_num"]),
+        "float": int(meta["edge_float_feature_num"]),
+        "binary": int(meta["edge_binary_feature_num"]),
+    }
+    head = struct.pack(
+        "<QQif",
+        int(edge["src_id"]),
+        int(edge["dst_id"]),
+        int(edge["edge_type"]),
+        float(edge["weight"]),
+    )
+    return head + _pack_features(edge, slot_nums)
+
+
+def pack_block(node: dict, meta: dict) -> bytes:
+    """Serialize one node line into a framed block."""
+    edge_type_num = int(meta["edge_type_num"])
+    neighbor = node.get("neighbor", {}) or {}
+    group_sizes = []
+    group_weights = []
+    nbr_ids = []
+    nbr_ws = []
+    for t in range(edge_type_num):
+        group = neighbor.get(str(t), {}) or {}
+        group_sizes.append(len(group))
+        group_weights.append(float(sum(group.values())))
+        for dst, w in group.items():
+            nbr_ids.append(int(dst))
+            nbr_ws.append(float(w))
+
+    slot_nums = {
+        "uint64": int(meta["node_uint64_feature_num"]),
+        "float": int(meta["node_float_feature_num"]),
+        "binary": int(meta["node_binary_feature_num"]),
+    }
+    node_rec = b"".join(
+        [
+            struct.pack(
+                "<Qifi",
+                int(node["node_id"]),
+                int(node["node_type"]),
+                float(node["node_weight"]),
+                edge_type_num,
+            ),
+            struct.pack("<%di" % edge_type_num, *group_sizes),
+            struct.pack("<%df" % edge_type_num, *group_weights),
+            struct.pack("<%dQ" % len(nbr_ids), *nbr_ids),
+            struct.pack("<%df" % len(nbr_ws), *nbr_ws),
+            _pack_features(node, slot_nums),
+        ]
+    )
+
+    edges = [_pack_edge(e, meta) for e in node.get("edge", [])]
+    edge_sizes = [len(e) for e in edges]
+    # block_bytes counts everything after itself: the node_info_bytes field,
+    # the node record, the edge_num field, the edge size list, and the edges.
+    block_bytes = 4 + len(node_rec) + 4 + 4 * len(edges) + sum(edge_sizes)
+    return b"".join(
+        [
+            struct.pack("<ii", block_bytes, len(node_rec)),
+            node_rec,
+            struct.pack("<i%di" % len(edges), len(edges), *edge_sizes),
+            b"".join(edges),
+        ]
+    )
+
+
+def convert(
+    meta_path: str,
+    input_path: str,
+    output_prefix: str,
+    num_partitions: int = 1,
+) -> list[str]:
+    """Convert a JSON-lines graph into ``num_partitions`` .dat files.
+
+    Returns the list of written partition paths.
+    """
+    with open(meta_path) as f:
+        meta = json.load(f)
+    paths = ["%s_%d.dat" % (output_prefix, p) for p in range(num_partitions)]
+    outs: list[IO[bytes]] = [open(p, "wb") for p in paths]
+    try:
+        with open(input_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                node = json.loads(line)
+                p = int(node["node_id"]) % num_partitions
+                outs[p].write(pack_block(node, meta))
+    finally:
+        for o in outs:
+            o.close()
+    return paths
+
+
+def convert_dicts(
+    nodes: list[dict],
+    meta: dict,
+    output_prefix: str,
+    num_partitions: int = 1,
+) -> list[str]:
+    """Like :func:`convert` but from in-memory dicts (used by tests and the
+    synthetic benchmark generator)."""
+    paths = ["%s_%d.dat" % (output_prefix, p) for p in range(num_partitions)]
+    outs = [open(p, "wb") for p in paths]
+    try:
+        for node in nodes:
+            p = int(node["node_id"]) % num_partitions
+            outs[p].write(pack_block(node, meta))
+    finally:
+        for o in outs:
+            o.close()
+    return paths
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("meta", help="meta.json path")
+    ap.add_argument("input", help="JSON-lines graph path")
+    ap.add_argument("output_prefix", help="output prefix; writes <prefix>_<p>.dat")
+    ap.add_argument("--partitions", type=int, default=1)
+    args = ap.parse_args()
+    for p in convert(args.meta, args.input, args.output_prefix, args.partitions):
+        print(p)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""ctypes bindings for libeuler_graph.so (built from graph/_native)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libeuler_graph.so")
+
+_lib = None
+
+
+def build_native(force: bool = False) -> str:
+    """Build the native library with make if missing or stale."""
+    sources = [
+        os.path.join(_NATIVE_DIR, f)
+        for f in os.listdir(_NATIVE_DIR)
+        if f.endswith((".cc", ".h"))
+    ]
+    stale = force or not os.path.exists(_LIB_PATH) or any(
+        os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in sources
+    )
+    if stale:
+        subprocess.run(
+            ["make", "-s", "-j"], cwd=_NATIVE_DIR, check=True,
+            capture_output=True, text=True,
+        )
+    return _LIB_PATH
+
+
+def _sig(fn, restype, argtypes) -> None:
+    fn.restype = restype
+    fn.argtypes = argtypes
+
+
+def lib() -> ctypes.CDLL:
+    """Load (building if needed) and return the native library singleton."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native()
+    L = ctypes.CDLL(_LIB_PATH)
+    c = ctypes
+    p = c.c_void_p
+    u64p = c.POINTER(c.c_uint64)
+    i32p = c.POINTER(c.c_int32)
+    f32p = c.POINTER(c.c_float)
+    _sig(L.eg_last_error, c.c_char_p, [])
+    _sig(L.eg_create, p, [])
+    _sig(L.eg_destroy, None, [p])
+    _sig(L.eg_load, c.c_int, [p, c.c_char_p, c.c_int, c.c_int])
+    _sig(L.eg_load_files, c.c_int, [p, c.POINTER(c.c_char_p), c.c_int])
+    _sig(L.eg_seed, None, [c.c_uint64])
+    _sig(L.eg_num_nodes, c.c_int64, [p])
+    _sig(L.eg_num_edges, c.c_int64, [p])
+    _sig(L.eg_node_type_num, c.c_int32, [p])
+    _sig(L.eg_edge_type_num, c.c_int32, [p])
+    _sig(L.eg_feature_num, c.c_int32, [p, c.c_int])
+    _sig(L.eg_type_weight_sums, None, [p, c.c_int, f32p])
+    _sig(L.eg_sample_node, None, [p, c.c_int, c.c_int32, u64p])
+    _sig(L.eg_sample_edge, None, [p, c.c_int, c.c_int32, u64p, u64p, i32p])
+    _sig(L.eg_sample_node_with_src, None, [p, u64p, c.c_int, c.c_int, u64p])
+    _sig(L.eg_get_node_type, None, [p, u64p, c.c_int, i32p])
+    _sig(
+        L.eg_sample_neighbor,
+        None,
+        [p, u64p, c.c_int, i32p, c.c_int, c.c_int, c.c_uint64, u64p, f32p, i32p],
+    )
+    _sig(
+        L.eg_sample_fanout,
+        None,
+        [
+            p, u64p, c.c_int, i32p, i32p, i32p, c.c_int, c.c_uint64,
+            c.POINTER(u64p), c.POINTER(f32p), c.POINTER(i32p),
+        ],
+    )
+    _sig(L.eg_get_full_neighbor, p, [p, u64p, c.c_int, i32p, c.c_int, c.c_int])
+    _sig(
+        L.eg_get_top_k_neighbor,
+        None,
+        [p, u64p, c.c_int, i32p, c.c_int, c.c_int, c.c_uint64, u64p, f32p, i32p],
+    )
+    _sig(
+        L.eg_random_walk,
+        None,
+        [p, u64p, c.c_int, i32p, c.c_int, c.c_int, c.c_float, c.c_float,
+         c.c_uint64, u64p],
+    )
+    _sig(
+        L.eg_get_dense_feature,
+        None,
+        [p, u64p, c.c_int, i32p, i32p, c.c_int, f32p],
+    )
+    _sig(
+        L.eg_get_edge_dense_feature,
+        None,
+        [p, u64p, u64p, i32p, c.c_int, i32p, i32p, c.c_int, f32p],
+    )
+    _sig(L.eg_get_sparse_feature, p, [p, u64p, c.c_int, i32p, c.c_int])
+    _sig(
+        L.eg_get_edge_sparse_feature,
+        p,
+        [p, u64p, u64p, i32p, c.c_int, i32p, c.c_int],
+    )
+    _sig(L.eg_get_binary_feature, p, [p, u64p, c.c_int, i32p, c.c_int])
+    _sig(
+        L.eg_get_edge_binary_feature,
+        p,
+        [p, u64p, u64p, i32p, c.c_int, i32p, c.c_int],
+    )
+    _sig(L.eg_result_size, c.c_int64, [p, c.c_int, c.c_int])
+    _sig(L.eg_result_copy, None, [p, c.c_int, c.c_int, p])
+    _sig(L.eg_result_free, None, [p])
+    _lib = L
+    return L

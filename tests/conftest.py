@@ -1,0 +1,40 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh so every
+sharding test runs without TPU hardware (the driver separately dry-runs the
+multi-chip path)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+from tests.fixture_graph import FIXTURE_META, fixture_nodes, write_fixture
+
+
+@pytest.fixture(scope="session")
+def fixture_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("graph")
+    write_fixture(str(d), num_partitions=2)
+    return str(d)
+
+
+@pytest.fixture(scope="session")
+def graph(fixture_dir):
+    import euler_tpu
+
+    return euler_tpu.Graph(directory=fixture_dir)
+
+
+@pytest.fixture(scope="session")
+def meta():
+    return dict(FIXTURE_META)
+
+
+@pytest.fixture(scope="session")
+def nodes():
+    return fixture_nodes()

@@ -1,0 +1,83 @@
+"""Tiny deterministic heterogeneous fixture graph shared by all tests.
+
+Same role as the reference's 6-node test graph
+(reference tf_euler/python/euler_ops/testdata/graph.json + base_test.py:36-53):
+every op test loads this via the converter + native engine.
+
+7 nodes (ids 10..16), 2 node types, 2 edge types, dense/sparse/binary
+features on nodes and edges.
+"""
+
+import os
+
+import euler_tpu
+
+FIXTURE_META = {
+    "node_type_num": 2,
+    "edge_type_num": 2,
+    "node_uint64_feature_num": 2,
+    "node_float_feature_num": 2,
+    "node_binary_feature_num": 1,
+    "edge_uint64_feature_num": 1,
+    "edge_float_feature_num": 1,
+    "edge_binary_feature_num": 1,
+}
+
+# node id -> (type, weight, {edge_type: {dst: weight}})
+TOPOLOGY = {
+    10: (0, 1.0, {0: {11: 1.0, 12: 3.0}, 1: {13: 2.0}}),
+    11: (1, 2.0, {0: {12: 2.0}}),
+    12: (0, 3.0, {1: {13: 1.0, 14: 4.0}}),
+    13: (1, 4.0, {0: {10: 1.0}}),
+    14: (0, 5.0, {0: {15: 2.0}, 1: {11: 1.0}}),
+    15: (1, 6.0, {}),
+    16: (0, 1.0, {0: {10: 2.0, 11: 1.0, 12: 1.0}, 1: {13: 1.0, 15: 2.0}}),
+}
+
+
+def dense_f0(nid):
+    return [nid * 0.5, nid * 0.25]
+
+
+def fixture_nodes():
+    nodes = []
+    for nid, (ntype, w, nbrs) in TOPOLOGY.items():
+        edges = []
+        for t, group in nbrs.items():
+            for dst, ew in group.items():
+                edges.append(
+                    {
+                        "src_id": nid,
+                        "dst_id": dst,
+                        "edge_type": t,
+                        "weight": ew,
+                        "uint64_feature": {"0": [nid * 100 + dst]},
+                        "float_feature": {"0": [ew * 0.1]},
+                        "binary_feature": {"0": "e%d-%d" % (nid, dst)},
+                    }
+                )
+        nodes.append(
+            {
+                "node_id": nid,
+                "node_type": ntype,
+                "node_weight": w,
+                "neighbor": {
+                    str(t): {str(d): w2 for d, w2 in g.items()}
+                    for t, g in nbrs.items()
+                },
+                "uint64_feature": {"0": [nid, nid + 1], "1": [7]},
+                "float_feature": {"0": dense_f0(nid), "1": [1.0, 2.0, 3.0]},
+                "binary_feature": {"0": "n%d" % nid},
+                "edge": edges,
+            }
+        )
+    return nodes
+
+
+def write_fixture(directory, num_partitions=2):
+    return euler_tpu.convert_dicts(
+        fixture_nodes(),
+        FIXTURE_META,
+        os.path.join(directory, "part"),
+        num_partitions=num_partitions,
+    )

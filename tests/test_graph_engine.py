@@ -1,0 +1,225 @@
+"""Native graph engine tests.
+
+Mirrors the reference's op-level test strategy
+(reference tf_euler/python/euler_ops/*_test.py: exact assertions on
+neighbors/features, distributional assertions on samplers) plus the
+C++ weighted-collection distribution tests
+(reference euler/common/compact_weighted_collection_test.cc).
+"""
+
+import numpy as np
+import pytest
+
+from tests.fixture_graph import TOPOLOGY, dense_f0
+
+
+def test_counts(graph):
+    assert graph.num_nodes == 7
+    assert graph.num_edges == sum(
+        len(g) for _, _, nbrs in TOPOLOGY.values() for g in nbrs.values()
+    )
+    assert graph.node_type_num == 2
+    assert graph.edge_type_num == 2
+    assert graph.feature_num(0) == 2  # node u64
+    assert graph.feature_num(1) == 2  # node f32
+    assert graph.feature_num(2) == 1  # node binary
+    assert graph.feature_num(4) == 1  # edge f32
+
+
+def test_node_types(graph):
+    types = graph.node_types([10, 11, 12, 13, 14, 15, 16, 999])
+    np.testing.assert_array_equal(types, [0, 1, 0, 1, 0, 1, 0, -1])
+
+
+def test_type_weight_sums(graph):
+    wsum = graph.type_weight_sums()
+    # type 0: nodes 10,12,14,16 -> 1+3+5+1; type 1: 11,13,15 -> 2+4+6
+    np.testing.assert_allclose(wsum, [10.0, 12.0])
+
+
+def test_full_neighbor_sorted_merge(graph):
+    nbr, w, t, counts = graph.get_full_neighbor([10, 15, 16], [0, 1], sorted=True)
+    np.testing.assert_array_equal(counts, [3, 0, 5])
+    # node 10 over both types merged by id: 11(w1,t0), 12(w3,t0), 13(w2,t1)
+    np.testing.assert_array_equal(nbr[:3], [11, 12, 13])
+    np.testing.assert_allclose(w[:3], [1.0, 3.0, 2.0])
+    np.testing.assert_array_equal(t[:3], [0, 0, 1])
+    # node 16: 10,11,12 (t0) and 13,15 (t1), merged ascending
+    np.testing.assert_array_equal(nbr[3:], [10, 11, 12, 13, 15])
+    np.testing.assert_array_equal(t[3:], [0, 0, 0, 1, 1])
+
+
+def test_full_neighbor_type_filter(graph):
+    nbr, w, t, counts = graph.get_full_neighbor([10], [1])
+    np.testing.assert_array_equal(counts, [1])
+    np.testing.assert_array_equal(nbr, [13])
+    np.testing.assert_array_equal(t, [1])
+
+
+def test_sample_neighbor_distribution(graph):
+    n = 20000
+    ids, w, t = graph.sample_neighbor([10] * n, [0], 1)
+    ids = ids.reshape(-1)
+    counts = {v: int((ids == v).sum()) for v in (11, 12)}
+    assert counts[11] + counts[12] == n
+    # weights 1:3
+    assert abs(counts[12] / n - 0.75) < 0.02
+    # weights returned match the sampled edge
+    w = w.reshape(-1)
+    assert set(np.unique(w[ids == 11])) == {1.0}
+    assert set(np.unique(w[ids == 12])) == {3.0}
+
+
+def test_sample_neighbor_multi_type_distribution(graph):
+    n = 30000
+    ids, _, t = graph.sample_neighbor([10] * n, [0, 1], 1)
+    ids = ids.reshape(-1)
+    # distribution over union: 11:1, 12:3, 13:2 (total 6)
+    for v, p in ((11, 1 / 6), (12, 3 / 6), (13, 2 / 6)):
+        assert abs((ids == v).mean() - p) < 0.02
+
+
+def test_sample_neighbor_default_fill(graph):
+    ids, w, t = graph.sample_neighbor([15, 999], [0, 1], 3, default_node=-1)
+    np.testing.assert_array_equal(ids, -np.ones((2, 3), dtype=np.int64))
+    np.testing.assert_array_equal(w, np.zeros((2, 3), dtype=np.float32))
+    np.testing.assert_array_equal(t, -np.ones((2, 3), dtype=np.int32))
+
+
+def test_sample_node_distribution(graph):
+    n = 30000
+    ids = graph.sample_node(n, 0)
+    types = graph.node_types(ids)
+    assert set(np.unique(types)) == {0}
+    # weight-proportional within type 0: 10:1,12:3,14:5,16:1 of 10
+    for v, p in ((10, 0.1), (12, 0.3), (14, 0.5), (16, 0.1)):
+        assert abs((ids == v).mean() - p) < 0.02
+    # global: type proportions 10:12
+    ids = graph.sample_node(n, -1)
+    types = graph.node_types(ids)
+    assert abs((types == 0).mean() - 10 / 22) < 0.02
+
+
+def test_sample_edge(graph):
+    src, dst, t = graph.sample_edge(1000, 1)
+    assert set(np.unique(t)) == {1}
+    # all sampled edges exist in type-1 topology
+    for s, d in zip(src[:50], dst[:50]):
+        assert d in TOPOLOGY[s][2].get(1, {})
+
+
+def test_sample_node_with_src_types(graph):
+    negs = graph.sample_node_with_src([10, 11], 8)
+    assert negs.shape == (2, 8)
+    assert set(np.unique(graph.node_types(negs[0]))) == {0}
+    assert set(np.unique(graph.node_types(negs[1]))) == {1}
+
+
+def test_top_k_neighbor(graph):
+    ids, w, t = graph.get_top_k_neighbor([16, 15], [0, 1], 3, default_node=-1)
+    # node 16 weights: 10:2, 11:1, 12:1, 13:1, 15:2 -> top3 = {10,15} + one of the 1s
+    assert ids[0, 0] in (10, 15) and ids[0, 1] in (10, 15)
+    np.testing.assert_allclose(w[0, :2], [2.0, 2.0])
+    assert w[0, 2] == 1.0
+    # node 15 has no neighbors: all defaults
+    np.testing.assert_array_equal(ids[1], [-1, -1, -1])
+    np.testing.assert_array_equal(t[1], [-1, -1, -1])
+
+
+def test_dense_feature(graph):
+    f = graph.get_dense_feature([10, 14], [0, 1], [2, 3])
+    np.testing.assert_allclose(f[0], dense_f0(10) + [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(f[1], dense_f0(14) + [1.0, 2.0, 3.0])
+    # missing node -> zeros; short feature -> zero pad
+    f = graph.get_dense_feature([999, 10], [0], [4])
+    np.testing.assert_allclose(f[0], [0, 0, 0, 0])
+    np.testing.assert_allclose(f[1], dense_f0(10) + [0, 0])
+
+
+def test_sparse_feature(graph):
+    out = graph.get_sparse_feature([10, 11, 999], [0, 1])
+    vals0, counts0 = out[0]
+    np.testing.assert_array_equal(counts0, [2, 2, 0])
+    np.testing.assert_array_equal(vals0, [10, 11, 11, 12])
+    vals1, counts1 = out[1]
+    np.testing.assert_array_equal(counts1, [1, 1, 0])
+    np.testing.assert_array_equal(vals1, [7, 7])
+
+
+def test_binary_feature(graph):
+    (rows,) = graph.get_binary_feature([10, 15, 999], [0])
+    assert rows == [b"n10", b"n15", b""]
+
+
+def test_edge_features(graph):
+    f = graph.get_edge_dense_feature([10, 12], [12, 14], [0, 1], [0], [1])
+    np.testing.assert_allclose(f, [[0.3], [0.4]], atol=1e-6)
+    out = graph.get_edge_sparse_feature([10], [12], [0], [0])
+    vals, counts = out[0]
+    np.testing.assert_array_equal(vals, [1012])
+    np.testing.assert_array_equal(counts, [1])
+    (rows,) = graph.get_edge_binary_feature([10, 999], [12, 1], [0, 0], [0])
+    assert rows == [b"e10-12", b""]
+
+
+def test_random_walk_validity(graph):
+    walks = graph.random_walk([10, 16], [0, 1], 5)
+    assert walks.shape == (2, 6)
+    for row in walks:
+        for a, b in zip(row[:-1], row[1:]):
+            if b == -1:
+                continue  # dead end fill
+            nbrs = TOPOLOGY[a][2]
+            assert any(b in g for g in nbrs.values())
+    # isolated node walks straight to defaults
+    walks = graph.random_walk([15], [0, 1], 3)
+    np.testing.assert_array_equal(walks[0], [15, -1, -1, -1])
+
+
+def test_random_walk_biased(graph):
+    # Large p suppresses returning to the parent: from 13 the only neighbor
+    # is 10; from 10 with parent 13, neighbors are 11,12,13 — with p=1e6 the
+    # walk should essentially never step back to 13.
+    walks = graph.random_walk([13] * 2000, [0, 1], 2, p=1e6, q=1.0)
+    assert (walks[:, 1] == 10).all()
+    back = (walks[:, 2] == 13).mean()
+    assert back < 0.01
+    # and with tiny p it should almost always return
+    walks = graph.random_walk([13] * 2000, [0, 1], 2, p=1e-6, q=1.0)
+    assert (walks[:, 2] == 13).mean() > 0.99
+
+
+def test_sample_fanout(graph):
+    ids, ws, ts = graph.sample_fanout([10, 16], [[0], [0, 1]], [2, 3])
+    assert [a.shape for a in ids] == [(2,), (4,), (12,)]
+    # hop-1 samples are type-0 neighbors of the roots
+    for root, picks in ((10, ids[1][:2]), (16, ids[1][2:])):
+        for v in picks:
+            assert v in TOPOLOGY[root][2].get(0, {})
+    # hop-2 samples are neighbors (any type) of hop-1 nodes, or default fill
+    for i, parent in enumerate(ids[1]):
+        for v in ids[2][i * 3 : (i + 1) * 3]:
+            if v == -1:
+                continue
+            assert any(v in g for g in TOPOLOGY[parent][2].values())
+
+
+def test_shard_loading(fixture_dir):
+    import euler_tpu
+
+    g0 = euler_tpu.Graph(directory=fixture_dir, shard_idx=0, shard_num=2)
+    g1 = euler_tpu.Graph(directory=fixture_dir, shard_idx=1, shard_num=2)
+    assert g0.num_nodes + g1.num_nodes == 7
+    ids0 = set(int(i) for i in g0.sample_node(1000, -1))
+    ids1 = set(int(i) for i in g1.sample_node(1000, -1))
+    assert ids0.isdisjoint(ids1)
+    g0.close()
+    g1.close()
+
+
+def test_alias_sampling_uniformity(graph):
+    # Regression guard on the alias table itself: global type-1 node sampling
+    # matches node weights 11:2, 13:4, 15:6.
+    ids = graph.sample_node(30000, 1)
+    for v, p in ((11, 2 / 12), (13, 4 / 12), (15, 6 / 12)):
+        assert abs((ids == v).mean() - p) < 0.02
