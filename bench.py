@@ -1,0 +1,174 @@
+"""Headline benchmark: supervised GraphSAGE throughput on one TPU chip.
+
+Mirrors the reference's flagship recipe (reference examples/sage.py:80-98:
+batch 512, fanouts [10,10], dim 256, Adam) on a synthetic PPI-scale graph
+(56944 nodes, ~15 avg degree, 50-dim features, 121 labels — the PPI
+constants from reference tf_euler/python/ppi_main.py:24-33). The real PPI
+dataset is not downloadable in this zero-egress environment; the synthetic
+graph matches its scale so the sampling + compute cost is representative.
+
+Prints one JSON line:
+  {"metric": "edges/sec/chip", "value": N, "unit": "edges/s", "vs_baseline": r}
+
+"edges" counts sampled neighbor draws consumed per step
+(batch * (f1 + f1*f2) = 512 * 110), the standard GNN throughput metric.
+vs_baseline divides by BASELINE_TARGET = 2e6 edges/s/chip — the BASELINE.md
+north-star proxy (2x an assumed 1M edges/s for the reference's 8xV100-era
+distributed setup; the reference repo publishes no number, see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TARGET = 2_000_000.0  # edges/s/chip; see module docstring
+
+NUM_NODES = 56944
+AVG_DEGREE = 15
+FEATURE_DIM = 50
+LABEL_DIM = 121
+BATCH = 512
+FANOUTS = [10, 10]
+DIM = 256
+WARMUP = 5
+MEASURE = 30
+
+
+def build_synthetic_graph(cache_dir: str) -> str:
+    """Write a synthetic PPI-scale graph as .dat partitions (cached)."""
+    os.makedirs(cache_dir, exist_ok=True)
+    marker = os.path.join(cache_dir, "done")
+    if os.path.exists(marker):
+        return cache_dir
+    import euler_tpu
+
+    rng = np.random.default_rng(7)
+    meta = {
+        "node_type_num": 1,
+        "edge_type_num": 1,
+        "node_uint64_feature_num": 0,
+        "node_float_feature_num": 2,
+        "node_binary_feature_num": 0,
+        "edge_uint64_feature_num": 0,
+        "edge_float_feature_num": 0,
+        "edge_binary_feature_num": 0,
+    }
+    paths = ["%s/part_%d.dat" % (cache_dir, p) for p in range(4)]
+    outs = [open(p, "wb") for p in paths]
+    from euler_tpu.graph.convert import pack_block
+
+    degrees = rng.poisson(AVG_DEGREE, NUM_NODES).clip(1, 60)
+    for nid in range(NUM_NODES):
+        nbrs = rng.integers(0, NUM_NODES, degrees[nid])
+        node = {
+            "node_id": nid,
+            "node_type": 0,
+            "node_weight": 1.0,
+            "neighbor": {
+                "0": {str(int(d)): 1.0 for d in nbrs},
+            },
+            "uint64_feature": {},
+            "float_feature": {
+                # slot 0: labels (121 multi-hot), slot 1: features (50)
+                "0": rng.integers(0, 2, LABEL_DIM).astype(float).tolist(),
+                "1": rng.standard_normal(FEATURE_DIM).round(3).tolist(),
+            },
+            "binary_feature": {},
+            "edge": [],
+        }
+        outs[nid % 4].write(pack_block(node, meta))
+    for o in outs:
+        o.close()
+    open(marker, "w").write("ok")
+    return cache_dir
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    import euler_tpu
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import SupervisedGraphSage
+    from euler_tpu.parallel import make_mesh, prefetch, shard_batch
+
+    cache = os.environ.get(
+        "EULER_TPU_BENCH_CACHE", "/tmp/euler_tpu_bench_graph"
+    )
+    build_synthetic_graph(cache)
+    graph = euler_tpu.Graph(directory=cache)
+
+    model = SupervisedGraphSage(
+        label_idx=0,
+        label_dim=LABEL_DIM,
+        metapath=[[0], [0]],
+        fanouts=FANOUTS,
+        dim=DIM,
+        feature_idx=1,
+        feature_dim=FEATURE_DIM,
+        max_id=NUM_NODES - 1,
+    )
+
+    mesh = make_mesh()
+    n_chips = len(mesh.devices.reshape(-1))
+    opt = train_lib.get_optimizer("adam", 0.01)
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, graph.sample_node(BATCH, -1), opt
+    )
+    from euler_tpu.parallel import batch_sharding, replicated_sharding
+
+    rep = replicated_sharding(mesh)
+    state = jax.device_put(state, rep)
+    step_fn = jax.jit(
+        model.make_train_step(opt),
+        in_shardings=(rep, batch_sharding(mesh)),
+        out_shardings=(rep, rep, rep),
+        donate_argnums=(0,),
+    )
+
+    def make_batch(step):
+        return model.sample(graph, graph.sample_node(BATCH, -1))
+
+    edges_per_step = BATCH * (FANOUTS[0] + FANOUTS[0] * FANOUTS[1])
+
+    it = prefetch(make_batch, WARMUP + MEASURE, depth=3, num_threads=4)
+    losses = []
+    for i, batch in enumerate(it):
+        batch = shard_batch(batch, mesh)
+        if i == WARMUP:
+            jax.block_until_ready(state)
+            t0 = time.time()
+        state, loss, metric = step_fn(state, batch)
+        losses.append(loss)
+    jax.block_until_ready(losses[-1])
+    dt = time.time() - t0
+    sps = MEASURE / dt
+    edges_per_sec = edges_per_step * sps / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "edges/sec/chip",
+                "value": round(edges_per_sec, 1),
+                "unit": "edges/s",
+                "vs_baseline": round(edges_per_sec / BASELINE_TARGET, 3),
+                "detail": {
+                    "steps_per_sec": round(sps, 2),
+                    "batch": BATCH,
+                    "fanouts": FANOUTS,
+                    "dim": DIM,
+                    "chips": n_chips,
+                    "platform": jax.devices()[0].platform,
+                    "final_loss": float(np.asarray(losses[-1])),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

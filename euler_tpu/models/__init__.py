@@ -1,0 +1,4 @@
+from euler_tpu.models.base import Model, ModelOutput
+from euler_tpu.models.graphsage import GraphSage, SupervisedGraphSage
+
+__all__ = ["Model", "ModelOutput", "GraphSage", "SupervisedGraphSage"]

@@ -1,0 +1,164 @@
+"""Model zoo base classes.
+
+Reference equivalent: tf_euler/python/models/base.py (ModelOutput :28,
+UnsupervisedModel :41-105, SupervisedModel :181-234).
+
+Architecture: every model is a pair of phases —
+  sample(graph, inputs) -> batch dict        (host, numpy, inside prefetch)
+  module.apply(vars, batch) -> ModelOutput   (device, pure JAX, jitted)
+The reference interleaves graph ops into the TF graph; splitting them is
+what makes the device step a single static XLA program and lets the host
+sampler run ahead of the TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from euler_tpu.nn import metrics
+
+
+@dataclasses.dataclass
+class ModelOutput:
+    embedding: Any
+    loss: Any
+    metric_name: str
+    metric: Any  # scalar (mrr/acc) or f1 counts [tp, fp, fn]
+
+
+def supervised_decoder(logits, labels, sigmoid_loss: bool):
+    """Loss + hard predictions (reference models/base.py:207-221)."""
+    if sigmoid_loss:
+        loss = optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+        predictions = jnp.floor(nn.sigmoid(logits) + 0.5)
+    else:
+        loss = optax.softmax_cross_entropy(logits, labels).mean()
+        num_classes = logits.shape[-1]
+        predictions = nn.one_hot(jnp.argmax(logits, axis=-1), num_classes)
+    return loss, predictions
+
+
+def unsupervised_decoder(emb, emb_pos, emb_negs, xent_loss: bool):
+    """Negative-sampling decoder (reference models/base.py:82-95).
+
+    emb/emb_pos: [B, 1, d]; emb_negs: [B, num_negs, d].
+    """
+    logits = jnp.einsum("bid,bjd->bij", emb, emb_pos)  # [B,1,1]
+    neg_logits = jnp.einsum("bid,bjd->bij", emb, emb_negs)  # [B,1,negs]
+    mrr = metrics.mrr(logits, neg_logits)
+    if xent_loss:
+        true_xent = optax.sigmoid_binary_cross_entropy(
+            logits, jnp.ones_like(logits)
+        )
+        neg_xent = optax.sigmoid_binary_cross_entropy(
+            neg_logits, jnp.zeros_like(neg_logits)
+        )
+        loss = true_xent.sum() + neg_xent.sum()
+    else:
+        neg_cost = jax_logsumexp(neg_logits)
+        loss = -jnp.sum(logits - neg_cost)
+    return loss, mrr
+
+
+def jax_logsumexp(x):
+    import jax.scipy.special as jsp
+
+    return jsp.logsumexp(x, axis=2, keepdims=True)
+
+
+def shared_negs_decoder(emb, emb_pos, emb_negs, xent_loss: bool):
+    """UnsupervisedModelV2-style shared negatives
+    (reference models/base.py:152-165): emb_negs [num_negs, d] shared by the
+    whole batch."""
+    logits = jnp.einsum("bid,bjd->bij", emb, emb_pos)
+    neg_logits = jnp.einsum("bid,nd->bin", emb, emb_negs)
+    mrr = metrics.mrr(logits, neg_logits)
+    if xent_loss:
+        true_xent = optax.sigmoid_binary_cross_entropy(
+            logits, jnp.ones_like(logits)
+        )
+        neg_xent = optax.sigmoid_binary_cross_entropy(
+            neg_logits, jnp.zeros_like(neg_logits)
+        )
+        loss = true_xent.sum() + neg_xent.sum()
+    else:
+        neg_cost = jax_logsumexp(neg_logits)
+        loss = -jnp.sum(logits - neg_cost)
+    return loss, mrr
+
+
+class Model:
+    """Host-side model driver: owns config, builds the flax module, and
+    implements the sampling phase. Subclasses define:
+      module: nn.Module with __call__(batch) -> ModelOutput
+      sample(graph, inputs) -> batch dict (numpy arrays, fixed shapes)
+    and optionally sample_embed/embed for inference. Models with extra
+    device state (embedding stores) override init_state/make_train_step."""
+
+    metric_name = "loss"
+    batch_size_ratio = 1  # reference Model.batch_size_ratio
+
+    def __init__(self):
+        self.module: nn.Module = None
+
+    def sample(self, graph, inputs) -> dict:
+        raise NotImplementedError
+
+    # Inference phase: by default reuse the training batch layout.
+    def sample_embed(self, graph, inputs) -> dict:
+        return self.sample(graph, inputs)
+
+    # ---- device state & steps ----
+    def init_state(self, rng, graph, example_inputs, optimizer) -> dict:
+        batch = self.sample(graph, np.asarray(example_inputs))
+        variables = self.module.init(rng, batch)
+        params = variables["params"]
+        return {"params": params, "opt_state": optimizer.init(params)}
+
+    def make_train_step(self, optimizer):
+        """Pure (state, batch) -> (state, loss, metric); jitted by the
+        trainer with params replicated and batch sharded over 'data'."""
+
+        def train_step(state, batch):
+            def loss_fn(p):
+                out = self.module.apply({"params": p}, batch)
+                return out.loss, out
+
+            (loss, out), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state["params"])
+            updates, opt_state = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+            return (
+                {"params": params, "opt_state": opt_state},
+                loss,
+                out.metric,
+            )
+
+        return train_step
+
+    def make_eval_step(self):
+        def eval_step(state, batch):
+            out = self.module.apply({"params": state["params"]}, batch)
+            return out.loss, out.metric
+
+        return eval_step
+
+    def make_embed_step(self):
+        def embed_step(state, batch):
+            return self.module.apply(
+                {"params": state["params"]},
+                batch,
+                method=self.module.embed,
+            )
+
+        return embed_step

@@ -1,0 +1,291 @@
+"""GraphSAGE models (supervised + unsupervised).
+
+Reference equivalent: tf_euler/python/models/graphsage.py (:26 GraphSage,
+:59 SupervisedGraphSage) and examples/sage.py. Sampling (fanout + feature
+gather) runs on the host in one fused native call; the device module is the
+aggregation pyramid + decoder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import numpy as np
+
+from euler_tpu import ops
+from euler_tpu.models import base
+from euler_tpu.nn import metrics
+from euler_tpu.nn.encoders import SageEncoder, ShallowEncoder
+
+
+class _SupervisedSageModule(nn.Module):
+    fanouts: Sequence[int]
+    dim: int
+    num_classes: int
+    aggregator: str = "mean"
+    concat: bool = False
+    sigmoid_loss: bool = True
+    # node-encoder config
+    feature_dim: int = 0
+    max_id: int = -1
+    embedding_dim: int = 16
+    sparse_feature_max_ids: Sequence[int] = ()
+
+    def setup(self):
+        self.node_encoder = ShallowEncoder(
+            feature_dim=self.feature_dim,
+            max_id=self.max_id,
+            embedding_dim=self.embedding_dim,
+            sparse_feature_max_ids=self.sparse_feature_max_ids,
+        )
+        self.encoder = SageEncoder(
+            self.fanouts, self.dim, self.aggregator, self.concat
+        )
+        self.predict = nn.Dense(self.num_classes)
+
+    def embed(self, batch):
+        hidden = [self.node_encoder(f) for f in batch["hops"]]
+        return self.encoder(hidden)
+
+    def __call__(self, batch):
+        embedding = self.embed(batch)
+        logits = self.predict(embedding)
+        labels = batch["labels"]
+        loss, predictions = base.supervised_decoder(
+            logits, labels, self.sigmoid_loss
+        )
+        return base.ModelOutput(
+            embedding=embedding,
+            loss=loss,
+            metric_name="f1",
+            metric=metrics.f1_counts(labels, predictions),
+        )
+
+
+class SupervisedGraphSage(base.Model):
+    """Supervised node classification (reference models/graphsage.py:59-78,
+    examples/sage.py:51-76)."""
+
+    metric_name = "f1"
+
+    def __init__(
+        self,
+        label_idx: int,
+        label_dim: int,
+        metapath: Sequence[Sequence[int]],
+        fanouts: Sequence[int],
+        dim: int,
+        feature_idx: int = -1,
+        feature_dim: int = 0,
+        aggregator: str = "mean",
+        concat: bool = False,
+        max_id: int = -1,
+        use_id: bool = False,
+        embedding_dim: int = 16,
+        sparse_feature_idx: Sequence[int] = (),
+        sparse_feature_max_ids: Sequence[int] = (),
+        sparse_max_len: int = 16,
+        num_classes: Optional[int] = None,
+        sigmoid_loss: bool = True,
+    ):
+        super().__init__()
+        self.label_idx = label_idx
+        self.label_dim = label_dim
+        self.metapath = [list(m) for m in metapath]
+        self.fanouts = list(fanouts)
+        self.feature_idx = feature_idx
+        self.feature_dim = feature_dim
+        self.max_id = max_id
+        self.use_id = use_id
+        self.sparse_feature_idx = list(sparse_feature_idx)
+        self.sparse_feature_max_ids = list(sparse_feature_max_ids)
+        self.sparse_max_len = sparse_max_len
+        self.default_node = max_id + 1 if max_id >= 0 else -1
+        self.module = _SupervisedSageModule(
+            fanouts=tuple(fanouts),
+            dim=dim,
+            num_classes=num_classes or label_dim,
+            aggregator=aggregator,
+            concat=concat,
+            sigmoid_loss=sigmoid_loss,
+            feature_dim=feature_dim if feature_idx >= 0 else 0,
+            max_id=max_id if use_id else -1,
+            embedding_dim=embedding_dim,
+            sparse_feature_max_ids=tuple(sparse_feature_max_ids),
+        )
+
+    def _node_feats(self, graph, ids: np.ndarray) -> dict:
+        feats: dict = {}
+        if self.use_id:
+            feats["ids"] = ids.astype(np.int32)
+        if self.feature_idx >= 0:
+            feats["dense"] = graph.get_dense_feature(
+                ids, [self.feature_idx], [self.feature_dim]
+            )
+        if self.sparse_feature_idx:
+            feats["sparse"] = ops.get_sparse_feature(
+                graph,
+                ids,
+                self.sparse_feature_idx,
+                self.sparse_max_len,
+                default_values=[m + 1 for m in self.sparse_feature_max_ids],
+            )
+        return feats
+
+    def sample(self, graph, inputs) -> dict:
+        inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        ids_per_hop, _, _ = graph.sample_fanout(
+            inputs, self.metapath, self.fanouts, self.default_node
+        )
+        hops = [self._node_feats(graph, ids) for ids in ids_per_hop]
+        labels = graph.get_dense_feature(
+            inputs, [self.label_idx], [self.label_dim]
+        )
+        return {"hops": hops, "labels": labels}
+
+
+class _UnsupervisedSageModule(nn.Module):
+    fanouts: Sequence[int]
+    dim: int
+    aggregator: str = "mean"
+    concat: bool = False
+    xent_loss: bool = False
+    feature_dim: int = 0
+    max_id: int = -1
+    embedding_dim: int = 16
+    sparse_feature_max_ids: Sequence[int] = ()
+    shared_negs: bool = False
+
+    def setup(self):
+        self.node_encoder = ShallowEncoder(
+            feature_dim=self.feature_dim,
+            max_id=self.max_id,
+            embedding_dim=self.embedding_dim,
+            sparse_feature_max_ids=self.sparse_feature_max_ids,
+        )
+        self.encoder = SageEncoder(
+            self.fanouts, self.dim, self.aggregator, self.concat
+        )
+        # Context encoder: separate tower over the same input layout
+        # (reference GraphSage.{target,context}_encoder are two encoders,
+        # models/graphsage.py:26-56).
+        self.context_node_encoder = ShallowEncoder(
+            feature_dim=self.feature_dim,
+            max_id=self.max_id,
+            embedding_dim=self.embedding_dim,
+            sparse_feature_max_ids=self.sparse_feature_max_ids,
+        )
+        self.context_encoder = SageEncoder(
+            self.fanouts, self.dim, self.aggregator, self.concat
+        )
+
+    def _encode(self, hops, context: bool):
+        if context:
+            hidden = [self.context_node_encoder(f) for f in hops]
+            return self.context_encoder(hidden)
+        hidden = [self.node_encoder(f) for f in hops]
+        return self.encoder(hidden)
+
+    def embed(self, batch):
+        return self._encode(batch["src_hops"], context=False)
+
+    def __call__(self, batch):
+        emb = self._encode(batch["src_hops"], context=False)
+        emb_pos = self._encode(batch["pos_hops"], context=True)
+        emb_negs = self._encode(batch["neg_hops"], context=True)
+        B = emb.shape[0]
+        emb3 = emb.reshape(B, 1, -1)
+        pos3 = emb_pos.reshape(B, 1, -1)
+        if self.shared_negs:
+            loss, mrr = base.shared_negs_decoder(
+                emb3, pos3, emb_negs, self.xent_loss
+            )
+        else:
+            negs3 = emb_negs.reshape(B, -1, emb.shape[-1])
+            loss, mrr = base.unsupervised_decoder(
+                emb3, pos3, negs3, self.xent_loss
+            )
+        return base.ModelOutput(
+            embedding=emb, loss=loss, metric_name="mrr", metric=mrr
+        )
+
+
+class GraphSage(base.Model):
+    """Unsupervised GraphSAGE (reference models/graphsage.py:26-56):
+    positives are 1-hop neighbors, negatives are global typed samples."""
+
+    metric_name = "mrr"
+
+    def __init__(
+        self,
+        node_type: int,
+        edge_type: Sequence[int],
+        max_id: int,
+        metapath: Sequence[Sequence[int]],
+        fanouts: Sequence[int],
+        dim: int,
+        num_negs: int = 5,
+        feature_idx: int = -1,
+        feature_dim: int = 0,
+        aggregator: str = "mean",
+        concat: bool = False,
+        xent_loss: bool = False,
+        use_id: bool = False,
+        embedding_dim: int = 16,
+    ):
+        super().__init__()
+        self.node_type = node_type
+        self.edge_type = list(edge_type)
+        self.max_id = max_id
+        self.metapath = [list(m) for m in metapath]
+        self.fanouts = list(fanouts)
+        self.num_negs = num_negs
+        self.feature_idx = feature_idx
+        self.feature_dim = feature_dim
+        self.use_id = use_id
+        self.default_node = max_id + 1
+        self.module = _UnsupervisedSageModule(
+            fanouts=tuple(fanouts),
+            dim=dim,
+            aggregator=aggregator,
+            concat=concat,
+            xent_loss=xent_loss,
+            feature_dim=feature_dim if feature_idx >= 0 else 0,
+            max_id=max_id if use_id else -1,
+            embedding_dim=embedding_dim,
+        )
+
+    def _hops(self, graph, ids: np.ndarray) -> list:
+        ids_per_hop, _, _ = graph.sample_fanout(
+            ids, self.metapath, self.fanouts, self.default_node
+        )
+        out = []
+        for hop_ids in ids_per_hop:
+            feats = {}
+            if self.use_id:
+                feats["ids"] = hop_ids.astype(np.int32)
+            if self.feature_idx >= 0:
+                feats["dense"] = graph.get_dense_feature(
+                    hop_ids, [self.feature_idx], [self.feature_dim]
+                )
+            out.append(feats)
+        return out
+
+    def sample(self, graph, inputs) -> dict:
+        inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        pos, _, _ = graph.sample_neighbor(
+            inputs, self.edge_type, 1, self.default_node
+        )
+        negs = graph.sample_node(
+            len(inputs) * self.num_negs, self.node_type
+        )
+        return {
+            "src_hops": self._hops(graph, inputs),
+            "pos_hops": self._hops(graph, pos.reshape(-1)),
+            "neg_hops": self._hops(graph, negs),
+        }
+
+    def sample_embed(self, graph, inputs) -> dict:
+        inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        return {"src_hops": self._hops(graph, inputs)}

@@ -1,0 +1,18 @@
+from euler_tpu.nn import aggregators, layers, metrics, sparse_aggregators
+from euler_tpu.nn.encoders import (
+    GCNEncoder,
+    SageEncoder,
+    ScalableSageEncoder,
+    ShallowEncoder,
+)
+
+__all__ = [
+    "aggregators",
+    "layers",
+    "metrics",
+    "sparse_aggregators",
+    "GCNEncoder",
+    "SageEncoder",
+    "ScalableSageEncoder",
+    "ShallowEncoder",
+]

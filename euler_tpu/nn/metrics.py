@@ -1,0 +1,50 @@
+"""Streaming metrics.
+
+Reference equivalent: tf_euler/python/metrics.py (streaming f1 from
+tp/fp/fn :23-34, mrr :36-44). JAX is functional, so the streaming state is
+an explicit counts pytree the training loop threads through steps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def f1_counts(labels, predictions):
+    """Per-batch (tp, fp, fn) for micro-F1 accumulation. Inputs binarize
+    like tf.metrics.true_positives (cast to bool)."""
+    labels = (labels != 0).astype(jnp.float32)
+    predictions = (predictions != 0).astype(jnp.float32)
+    tp = jnp.sum(predictions * labels)
+    fp = jnp.sum(predictions * (1.0 - labels))
+    fn = jnp.sum((1.0 - predictions) * labels)
+    return jnp.stack([tp, fp, fn])
+
+
+def f1_from_counts(counts) -> float:
+    """Micro-F1 from accumulated [tp, fp, fn]."""
+    tp, fp, fn = np.asarray(counts, dtype=np.float64)
+    eps = 1e-7
+    precision = tp / (eps + tp + fp)
+    recall = tp / (eps + tp + fn)
+    return float(2.0 * precision * recall / (precision + recall + eps))
+
+
+def mrr(logits, neg_logits):
+    """Mean reciprocal rank of the positive among its negatives.
+
+    logits: [..., 1, 1]; neg_logits: [..., 1, k]. Ties resolve against the
+    positive (matches the reference's double-top_k construction where the
+    positive is the last column).
+    """
+    rank = 1.0 + jnp.sum(neg_logits >= logits, axis=-1)
+    return jnp.mean(1.0 / rank)
+
+
+def accuracy(labels, predictions):
+    return jnp.mean(
+        (jnp.argmax(labels, -1) == jnp.argmax(predictions, -1)).astype(
+            jnp.float32
+        )
+    )

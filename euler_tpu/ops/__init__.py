@@ -1,0 +1,37 @@
+"""Host-side graph query ops with TPU-friendly (fixed-shape) outputs.
+
+Equivalent surface to the reference's Python op wrappers
+(reference tf_euler/python/euler_ops/{neighbor,sample,feature,walk}_ops.py),
+re-designed for the JAX split: these run on the host (inside the prefetch
+pipeline), and everything they return is either exactly-shaped or padded +
+masked so the device step can be jitted with static shapes.
+"""
+
+from euler_tpu.ops.neighbor import (
+    MultiHop,
+    get_multi_hop_neighbor,
+    sample_fanout,
+    sample_neighbor,
+)
+from euler_tpu.ops.feature import (
+    get_dense_feature,
+    get_edge_dense_feature,
+    get_sparse_feature,
+)
+from euler_tpu.ops.sample import sample_edge, sample_node, sample_node_with_src
+from euler_tpu.ops.walk import gen_pair, random_walk
+
+__all__ = [
+    "MultiHop",
+    "get_multi_hop_neighbor",
+    "sample_fanout",
+    "sample_neighbor",
+    "get_dense_feature",
+    "get_edge_dense_feature",
+    "get_sparse_feature",
+    "sample_edge",
+    "sample_node",
+    "sample_node_with_src",
+    "gen_pair",
+    "random_walk",
+]

@@ -1,0 +1,62 @@
+"""Feature gather ops.
+
+Reference equivalent: tf_euler/python/euler_ops/feature_ops.py. Dense gather
+is already fixed-shape; sparse (uint64 id-list) features are returned padded
++ masked instead of as tf.SparseTensor, ready for embedding-lookup +
+masked-combine on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def get_dense_feature(g, nodes, feature_ids, dimensions):
+    """[n, sum(dimensions)] float32 (zero-padded per slot)."""
+    return g.get_dense_feature(nodes, feature_ids, dimensions)
+
+
+def get_edge_dense_feature(g, src, dst, types, feature_ids, dimensions):
+    return g.get_edge_dense_feature(src, dst, types, feature_ids, dimensions)
+
+
+def get_sparse_feature(
+    g, nodes, feature_ids, max_len, default_values=None, edge=None
+):
+    """Padded sparse (id-list) features.
+
+    Args:
+      max_len: per-slot pad length (int or list). Longer rows are truncated.
+      default_values: per-slot fill id for padding positions (defaults to 0;
+        the reference uses max_id+1, pass that for parity with
+        ShallowEncoder semantics).
+      edge: optional (src, dst, types) triple to gather edge features
+        instead of node features.
+
+    Returns per slot: (ids [n, max_len] int64, mask [n, max_len] float32).
+    """
+    nslots = len(feature_ids)
+    if isinstance(max_len, int):
+        max_len = [max_len] * nslots
+    if default_values is None:
+        default_values = [0] * nslots
+    if edge is not None:
+        raw = g.get_edge_sparse_feature(*edge, feature_ids)
+    else:
+        raw = g.get_sparse_feature(nodes, feature_ids)
+    out = []
+    for k in range(nslots):
+        vals, counts = raw[k]
+        n = len(counts)
+        L = max_len[k]
+        ids = np.full((n, L), default_values[k], dtype=np.int64)
+        mask = np.zeros((n, L), dtype=np.float32)
+        off = 0
+        for i, c in enumerate(counts):
+            c = int(c)
+            take = min(c, L)
+            ids[i, :take] = vals[off : off + take]
+            mask[i, :take] = 1.0
+            off += c
+        out.append((ids, mask))
+    return out

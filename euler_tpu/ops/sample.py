@@ -1,0 +1,21 @@
+"""Global sampling ops.
+
+Reference equivalent: tf_euler/python/euler_ops/sample_ops.py. The typed
+negative sampler (sample_node_with_src) is a single native batch call here —
+the reference needed a unique/while_loop/inflate_idx TF pipeline
+(sample_ops.py:39-67) because per-row typed draws were awkward in TF; the
+host engine does it directly.
+"""
+
+
+def sample_node(g, count, node_type=-1):
+    return g.sample_node(count, node_type)
+
+
+def sample_edge(g, count, edge_type=-1):
+    return g.sample_edge(count, edge_type)
+
+
+def sample_node_with_src(g, src_nodes, count):
+    """[n, count] negatives drawn from each src node's type distribution."""
+    return g.sample_node_with_src(src_nodes, count)

@@ -1,0 +1,56 @@
+"""Random walk + skip-gram pair ops.
+
+Reference equivalents: tf_euler/python/euler_ops/walk_ops.py, the RandomWalk
+async kernel chain (tf_euler/kernels/random_walk_op.cc:31-140 — walk_len
+sequential round trips) and GenPair (tf_euler/kernels/gen_pair_op.cc:43-95).
+The walk here is one native call that runs the whole chain inside the
+engine; gen_pair is vectorized numpy with the same enumeration order and the
+same exact (dense, unpadded) pair count.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def random_walk(g, nodes, edge_types, walk_len, p=1.0, q=1.0, default_node=-1):
+    """[n, walk_len+1] int64 node2vec walks (column 0 = start)."""
+    return g.random_walk(nodes, edge_types, walk_len, p, q, default_node)
+
+
+def pair_count(path_len: int, left_win: int, right_win: int) -> int:
+    """Exact number of skip-gram pairs per path (matches the reference's
+    static shape function, tf_euler/ops/walk_ops.cc:40-54)."""
+    count = path_len * (left_win + right_win)
+    for i in range(min(left_win, path_len)):
+        count -= left_win - i
+    for i in range(min(right_win, path_len)):
+        count -= right_win - i
+    return count
+
+
+def gen_pair(paths, left_win_size: int, right_win_size: int) -> np.ndarray:
+    """[batch, pair_count, 2] (target, context) pairs.
+
+    Enumeration order per row matches the reference kernel: positions
+    j = 0..len-1, for each j the left contexts j-1, j-2, ... then the right
+    contexts j+1, j+2, ...
+    """
+    paths = np.asarray(paths, dtype=np.int64)
+    if paths.ndim == 1:
+        paths = paths[None, :]
+    batch, path_len = paths.shape
+    blocks = []
+    for j in range(path_len):
+        for k in range(left_win_size):
+            if j - k - 1 >= 0:
+                blocks.append((j, j - k - 1))
+        for k in range(right_win_size):
+            if j + k + 1 < path_len:
+                blocks.append((j, j + k + 1))
+    if not blocks:
+        return np.zeros((batch, 0, 2), dtype=np.int64)
+    tgt_idx = np.array([b[0] for b in blocks])
+    ctx_idx = np.array([b[1] for b in blocks])
+    pairs = np.stack([paths[:, tgt_idx], paths[:, ctx_idx]], axis=-1)
+    return pairs

@@ -1,0 +1,15 @@
+from euler_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+from euler_tpu.parallel.prefetch import prefetch
+
+__all__ = [
+    "batch_sharding",
+    "make_mesh",
+    "replicated_sharding",
+    "shard_batch",
+    "prefetch",
+]

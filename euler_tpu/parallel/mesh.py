@@ -1,0 +1,39 @@
+"""Device mesh + sharding rules.
+
+The TPU replacement for the reference's parameter-server data parallelism
+(reference tf_euler/python/run_loop.py:371-397 ClusterSpec{ps,worker} +
+replica_device_setter): parameters are replicated across the mesh, each
+batch is sharded over the 'data' axis, and XLA inserts the gradient
+all-reduce over ICI inside the jitted train step. No parameter servers,
+no explicit gradient exchange code.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """1-D data-parallel mesh over the first num_devices devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), ("data",))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over 'data'."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host batch pytree onto the mesh, leading dim sharded."""
+    sharding = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

@@ -1,0 +1,89 @@
+"""Host->device prefetch pipeline.
+
+The TPU replacement for the reference's AsyncOpKernel machinery
+(reference tf_euler/kernels/*.cc ComputeAsync + callback chains): instead of
+async graph ops inside the step graph, the sampler runs in background
+threads (the native engine releases the GIL) producing batch k+1..k+depth
+while the device computes step k.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+
+def prefetch(
+    make_batch: Callable[[int], dict],
+    num_steps: int,
+    depth: int = 2,
+    num_threads: int = 2,
+) -> Iterator[dict]:
+    """Yield num_steps batches, produced ahead of time by worker threads.
+
+    make_batch(step) must be thread-safe (the graph engine is: the store is
+    immutable and RNG is thread-local).
+    """
+    if num_threads <= 1 or depth <= 0:
+        for step in range(num_steps):
+            yield make_batch(step)
+        return
+
+    out: "queue.Queue" = queue.Queue()
+    cv = threading.Condition()
+    next_step = [0]  # next step a worker may claim
+    consumed = [0]  # steps the consumer has yielded
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            with cv:
+                # Backpressure: never run more than `depth` steps ahead of
+                # the consumer, even across the reorder buffer — otherwise a
+                # slow step would let the other workers produce (and retain)
+                # arbitrarily many batches.
+                while (
+                    not stop.is_set()
+                    and next_step[0] < num_steps
+                    and next_step[0] - consumed[0] >= depth + 1
+                ):
+                    cv.wait(timeout=0.1)
+                step = next_step[0]
+                if stop.is_set() or step >= num_steps:
+                    return
+                next_step[0] = step + 1
+            try:
+                batch = make_batch(step)
+            except Exception as e:  # surface errors to the consumer
+                out.put((step, e))
+                return
+            out.put((step, batch))
+
+    threads = [
+        threading.Thread(target=worker, daemon=True)
+        for _ in range(num_threads)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        # Reorder: batches may complete out of order with >1 worker. The
+        # pending dict is bounded by depth+1 thanks to the backpressure.
+        pending: dict[int, object] = {}
+        for want in range(num_steps):
+            while want not in pending:
+                step, item = out.get()
+                pending[step] = item
+            item = pending.pop(want)
+            if isinstance(item, Exception):
+                raise item
+            yield item
+            with cv:
+                consumed[0] = want + 1
+                cv.notify_all()
+    finally:
+        stop.set()
+        with cv:
+            cv.notify_all()
+        for t in threads:
+            t.join(timeout=1.0)

@@ -1,0 +1,199 @@
+"""Training / evaluation / embedding-export driver.
+
+Reference equivalent: tf_euler/python/run_loop.py (run_train :95-140,
+run_evaluate :143-171, run_save_embedding :174-219) — rebuilt for JAX:
+MonitoredTrainingSession becomes an explicit loop over a jitted train step;
+PS placement becomes mesh sharding (see parallel/mesh.py); the input
+pipeline is the host sampler behind a prefetch queue.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+import optax
+
+from euler_tpu.nn import metrics as metrics_lib
+from euler_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    prefetch,
+    replicated_sharding,
+    shard_batch,
+)
+
+log = logging.getLogger("euler_tpu")
+
+OPTIMIZERS = {
+    "sgd": optax.sgd,
+    "momentum": lambda lr: optax.sgd(lr, momentum=0.9),
+    "adagrad": optax.adagrad,
+    "adam": optax.adam,
+}
+
+
+def get_optimizer(name: str, lr: float):
+    """Reference tf_euler/python/optimizers.py registry."""
+    return OPTIMIZERS[name](lr)
+
+
+def _metric_value(name: str, acc) -> float:
+    if name == "f1":
+        return metrics_lib.f1_from_counts(acc)
+    return float(acc[0] / max(acc[1], 1))  # running mean
+
+
+def _metric_accumulate(name: str, acc, value):
+    value = np.asarray(value)
+    if name == "f1":
+        return acc + value
+    return np.array([acc[0] + float(value), acc[1] + 1.0])
+
+
+def _metric_zero(name: str):
+    return np.zeros(3) if name == "f1" else np.zeros(2)
+
+
+def train(
+    model,
+    graph,
+    source_fn: Callable[[int], np.ndarray],
+    num_steps: int,
+    optimizer: str = "adam",
+    learning_rate: float = 0.01,
+    mesh=None,
+    log_every: int = 100,
+    seed: int = 42,
+    prefetch_depth: int = 2,
+    prefetch_threads: int = 2,
+    state: Optional[dict] = None,
+    log_fn=None,
+):
+    """Train and return (state, history).
+
+    source_fn(step) -> int64 root-node batch (fixed size, divisible by the
+    mesh size). All sampling runs in the prefetch workers.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    opt = get_optimizer(optimizer, learning_rate)
+    if state is None:
+        state = model.init_state(
+            jax.random.PRNGKey(seed), graph, source_fn(0), opt
+        )
+    rep = replicated_sharding(mesh)
+    state = jax.device_put(state, rep)
+    step_fn = jax.jit(
+        model.make_train_step(opt),
+        in_shardings=(rep, batch_sharding(mesh)),
+        out_shardings=(rep, rep, rep),
+        donate_argnums=(0,),
+    )
+
+    def make_batch(step):
+        return model.sample(graph, source_fn(step))
+
+    name = model.metric_name
+    history = []
+    t0 = time.time()
+    # Metrics stay on device inside the logging window — forcing them to
+    # host every step would sync the pipeline and stall the prefetch overlap
+    # (JAX dispatch is async; only materialize at the log boundary).
+    window_metrics = []
+    last_loss = None
+    steps_done = 0
+
+    def flush():
+        nonlocal window_metrics, t0
+        acc = _metric_zero(name)
+        for m in window_metrics:
+            acc = _metric_accumulate(name, acc, m)
+        loss_v = float(last_loss)
+        mv = _metric_value(name, acc)
+        dt = time.time() - t0
+        sps = len(window_metrics) / dt
+        history.append({"loss": loss_v, name: mv, "steps_per_sec": sps})
+        (log_fn or log.info)(
+            f"step={steps_done} loss={loss_v:.4f} "
+            f"{name}={mv:.4f} steps/s={sps:.2f}"
+        )
+        window_metrics = []
+        t0 = time.time()
+
+    for batch in prefetch(
+        make_batch, num_steps, prefetch_depth, prefetch_threads
+    ):
+        batch = shard_batch(batch, mesh)
+        state, last_loss, metric = step_fn(state, batch)
+        window_metrics.append(metric)
+        steps_done += 1
+        if len(window_metrics) == log_every:
+            flush()
+    if window_metrics:  # final partial window
+        flush()
+    return state, history
+
+
+def evaluate(
+    model,
+    graph,
+    source_iter,
+    state,
+    mesh=None,
+    log_fn=None,
+):
+    """Streaming evaluation over an iterator of root-node batches
+    (reference run_loop.py:143-171)."""
+    if mesh is None:
+        mesh = make_mesh()
+    rep = replicated_sharding(mesh)
+    eval_fn = jax.jit(
+        model.make_eval_step(),
+        in_shardings=(rep, batch_sharding(mesh)),
+        out_shardings=(rep, rep),
+    )
+    name = model.metric_name
+    acc = _metric_zero(name)
+    losses = []
+    for ids in source_iter:
+        batch = shard_batch(model.sample(graph, ids), mesh)
+        loss, metric = eval_fn(state, batch)
+        acc = _metric_accumulate(name, acc, metric)
+        losses.append(float(loss))
+    result = {name: _metric_value(name, acc), "loss": float(np.mean(losses))}
+    (log_fn or log.info)(f"eval: {result}")
+    return result
+
+
+def save_embedding(
+    model,
+    graph,
+    max_id: int,
+    state,
+    batch_size: int = 1024,
+    mesh=None,
+):
+    """Export embeddings for ids 0..max_id as a [max_id+1, dim] array
+    (reference run_loop.py:174-219 exports .npy + id file)."""
+    if mesh is None:
+        mesh = make_mesh()
+    rep = replicated_sharding(mesh)
+    embed_fn = jax.jit(
+        model.make_embed_step(),
+        in_shardings=(rep, batch_sharding(mesh)),
+        out_shardings=batch_sharding(mesh),
+    )
+    chunks = []
+    ids = np.arange(max_id + 1, dtype=np.int64)
+    pad = (-len(ids)) % batch_size
+    padded = np.concatenate([ids, np.zeros(pad, dtype=np.int64)])
+    for i in range(0, len(padded), batch_size):
+        chunk = padded[i : i + batch_size]
+        batch = shard_batch(model.sample_embed(graph, chunk), mesh)
+        chunks.append(np.asarray(embed_fn(state, batch)))
+    out = np.concatenate(chunks, axis=0)[: len(ids)]
+    return out

@@ -4,12 +4,19 @@ multi-chip path)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The environment preloads jax (axon sitecustomize) with JAX_PLATFORMS=axon,
+# so env vars alone are too late; the backend is still uninitialized at
+# conftest time, so config.update + XLA_FLAGS here take effect.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

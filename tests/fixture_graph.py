@@ -16,7 +16,7 @@ FIXTURE_META = {
     "node_type_num": 2,
     "edge_type_num": 2,
     "node_uint64_feature_num": 2,
-    "node_float_feature_num": 2,
+    "node_float_feature_num": 3,
     "node_binary_feature_num": 1,
     "edge_uint64_feature_num": 1,
     "edge_float_feature_num": 1,
@@ -66,7 +66,17 @@ def fixture_nodes():
                     for t, g in nbrs.items()
                 },
                 "uint64_feature": {"0": [nid, nid + 1], "1": [7]},
-                "float_feature": {"0": dense_f0(nid), "1": [1.0, 2.0, 3.0]},
+                "float_feature": {
+                    "0": dense_f0(nid),
+                    "1": [1.0, 2.0, 3.0],
+                    # slot 2: a 3-class multi-hot label (nid mod 3 one-hot,
+                    # plus class 2 for even ids) for supervised-model tests
+                    "2": [
+                        1.0 if nid % 3 == 0 else 0.0,
+                        1.0 if nid % 3 == 1 else 0.0,
+                        1.0 if nid % 2 == 0 else 0.0,
+                    ],
+                },
                 "binary_feature": {"0": "n%d" % nid},
                 "edge": edges,
             }

@@ -21,7 +21,7 @@ def test_counts(graph):
     assert graph.node_type_num == 2
     assert graph.edge_type_num == 2
     assert graph.feature_num(0) == 2  # node u64
-    assert graph.feature_num(1) == 2  # node f32
+    assert graph.feature_num(1) == 3  # node f32
     assert graph.feature_num(2) == 1  # node binary
     assert graph.feature_num(4) == 1  # edge f32
 

@@ -1,0 +1,103 @@
+"""Ops-layer tests (reference analog: euler_ops/*_test.py)."""
+
+import numpy as np
+
+from euler_tpu import ops
+from tests.fixture_graph import TOPOLOGY
+
+
+def test_multi_hop_exact(graph):
+    roots, hops = ops.get_multi_hop_neighbor(graph, [10, 16], [[0], [0, 1]])
+    np.testing.assert_array_equal(roots, [10, 16])
+    h0 = hops[0]
+    # type-0 neighbors of 10: {11,12}; of 16: {10,11,12} -> unique {10,11,12}
+    np.testing.assert_array_equal(h0.nodes, [10, 11, 12])
+    assert h0.num_edges == 5
+    # every edge maps correctly
+    for s, d, w in zip(h0.adj_src, h0.adj_dst, h0.adj_w):
+        root = roots[s]
+        dst = h0.nodes[d]
+        assert dst in TOPOLOGY[root][2].get(0, {})
+        assert TOPOLOGY[root][2][0][dst] == w
+
+
+def test_multi_hop_padded(graph):
+    roots, hops = ops.get_multi_hop_neighbor(
+        graph,
+        [10, 16],
+        [[0], [0, 1]],
+        max_nodes_per_hop=[8, 16],
+        max_edges_per_hop=[8, 32],
+        default_node=-1,
+    )
+    h0, h1 = hops
+    assert h0.nodes.shape == (8,) and h0.adj_src.shape == (8,)
+    assert h1.nodes.shape == (16,) and h1.adj_w.shape == (32,)
+    # padding nodes are default, padding edges have zero weight
+    np.testing.assert_array_equal(h0.nodes[h0.num_nodes :], [-1] * (8 - h0.num_nodes))
+    assert (h0.adj_w[h0.num_edges :] == 0).all()
+    # second hop: every real edge goes from a hop-1 unique node to one of
+    # its actual topological neighbors
+    for s, d in zip(h1.adj_src[: h1.num_edges], h1.adj_dst[: h1.num_edges]):
+        assert int(s) < h0.num_nodes
+        src_node = int(h0.nodes[int(s)])
+        dst_node = int(h1.nodes[int(d)])
+        assert any(
+            dst_node in g for g in TOPOLOGY[src_node][2].values()
+        ), (src_node, dst_node)
+    # adj dict form exposes a correct padding mask
+    adj = h1.adj
+    assert adj["mask"].sum() == h1.num_edges
+    assert set(adj) == {"src", "dst", "w", "mask"}
+
+
+def test_multi_hop_cap_overflow(graph):
+    try:
+        ops.get_multi_hop_neighbor(
+            graph, [16], [[0, 1]], max_nodes_per_hop=[2], max_edges_per_hop=[32]
+        )
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "cap" in str(e)
+
+
+def test_sparse_feature_padded(graph):
+    out = ops.get_sparse_feature(
+        graph, [10, 15, 999], [0, 1], max_len=3, default_values=[99, 88]
+    )
+    ids0, mask0 = out[0]
+    np.testing.assert_array_equal(ids0[0], [10, 11, 99])
+    np.testing.assert_array_equal(mask0[0], [1, 1, 0])
+    np.testing.assert_array_equal(ids0[2], [99, 99, 99])
+    np.testing.assert_array_equal(mask0[2], [0, 0, 0])
+    ids1, mask1 = out[1]
+    np.testing.assert_array_equal(ids1[1], [7, 88, 88])
+
+
+def test_gen_pair_count_and_content():
+    paths = np.array([[1, 2, 3, 4]])
+    pairs = ops.gen_pair(paths, 1, 1)
+    assert pairs.shape == (1, ops.walk.pair_count(4, 1, 1), 2)
+    assert pairs.shape[1] == 6
+    expected = {(1, 2), (2, 1), (2, 3), (3, 2), (3, 4), (4, 3)}
+    got = {tuple(p) for p in pairs[0]}
+    assert got == expected
+
+
+def test_gen_pair_matches_reference_order():
+    # Reference kernel order: j-major, left (j-1, j-2, ...) then right.
+    paths = np.array([[5, 6, 7]])
+    pairs = ops.gen_pair(paths, 2, 1)
+    expected = [
+        (5, 6),          # j=0 right
+        (6, 5), (6, 7),  # j=1 left(1) then right
+        (7, 6), (7, 5),  # j=2 left(1), left(2)
+    ]
+    assert [tuple(p) for p in pairs[0]] == expected
+    assert pairs.shape[1] == ops.walk.pair_count(3, 2, 1)
+
+
+def test_walk_to_pairs_pipeline(graph):
+    walks = ops.random_walk(graph, [10, 16, 13], [0, 1], 3)
+    pairs = ops.gen_pair(walks, 1, 1)
+    assert pairs.shape == (3, ops.walk.pair_count(4, 1, 1), 2)

@@ -1,0 +1,88 @@
+"""Sparse (COO segment-op) aggregator + GCNEncoder path tests — exercises
+the full-neighbor pipeline end to end: get_multi_hop_neighbor -> MultiHop.adj
+-> GCNEncoder."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from euler_tpu import ops
+from euler_tpu.nn import sparse_aggregators
+from euler_tpu.nn.encoders import GCNEncoder
+
+
+def _toy_adj():
+    # 2 self nodes, 3 neighbor nodes; node 0 -> {0, 1}, node 1 -> {2};
+    # one padding edge pointing at slot 0.
+    return {
+        "src": jnp.array([0, 0, 1, 0], dtype=jnp.int32),
+        "dst": jnp.array([0, 1, 2, 0], dtype=jnp.int32),
+        "w": jnp.array([1.0, 1.0, 1.0, 0.0]),
+        "mask": jnp.array([1.0, 1.0, 1.0, 0.0]),
+    }
+
+
+def test_gcn_aggregator_mean_semantics():
+    self_emb = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+    neigh_emb = jnp.array([[2.0, 0.0], [4.0, 0.0], [0.0, 6.0]])
+    adj = _toy_adj()
+    agg = sparse_aggregators.GCNAggregator(dim=2, activation=None)
+    params = agg.init(jax.random.PRNGKey(0), (self_emb, neigh_emb, adj))
+    # Pre-dense aggregation: node0 = self + mean(n0,n1) = [1,0]+[3,0];
+    # node1 = [0,1]+[0,6]. Verify via identity-kernel application.
+    params = jax.tree.map(
+        lambda p: jnp.eye(2) if p.shape == (2, 2) else p, params
+    )
+    out = agg.apply(params, (self_emb, neigh_emb, adj))
+    np.testing.assert_allclose(out, [[4.0, 0.0], [0.0, 7.0]], atol=1e-5)
+
+
+def test_padding_edges_do_not_contribute():
+    self_emb = jnp.ones((2, 4))
+    neigh_emb = jnp.ones((3, 4)) * 100.0
+    adj = _toy_adj()
+    # zero out ALL real edges; only the padding edge remains
+    adj = dict(adj, mask=jnp.array([0.0, 0.0, 0.0, 0.0]))
+    agg = sparse_aggregators.MeanAggregator(dim=4, activation=None)
+    params = agg.init(jax.random.PRNGKey(0), (self_emb, neigh_emb, adj))
+    out = agg.apply(params, (self_emb, neigh_emb, adj))
+    # with no real edges the neighbor term must be exactly zero, so the
+    # output equals the self projection alone
+    self_only = agg.apply(
+        params, (self_emb, jnp.zeros_like(neigh_emb), adj)
+    )
+    np.testing.assert_allclose(out, self_only, atol=1e-6)
+
+
+def test_segment_softmax_masks_padding():
+    logits = jnp.array([1.0, 2.0, 3.0, 100.0])
+    seg = jnp.array([0, 0, 1, 1])
+    mask = jnp.array([1.0, 1.0, 1.0, 0.0])
+    p = sparse_aggregators.segment_softmax(logits, seg, 2, mask)
+    np.testing.assert_allclose(p[3], 0.0)
+    np.testing.assert_allclose(p[0] + p[1], 1.0, atol=1e-6)
+    np.testing.assert_allclose(p[2], 1.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("aggregator", ["gcn", "mean", "attention"])
+def test_gcn_encoder_full_pipeline(graph, aggregator):
+    """ops.get_multi_hop_neighbor -> MultiHop.adj -> GCNEncoder, jitted."""
+    roots = np.array([10, 16], dtype=np.int64)
+    roots, hops = ops.get_multi_hop_neighbor(
+        graph,
+        roots,
+        [[0, 1], [0, 1]],
+        max_nodes_per_hop=[8, 8],
+        max_edges_per_hop=[16, 32],
+    )
+    feats = [graph.get_dense_feature(roots, [0], [2])] + [
+        graph.get_dense_feature(h.nodes, [0], [2]) for h in hops
+    ]
+    adjs = [h.adj for h in hops]
+    enc = GCNEncoder(num_layers=2, dim=8, aggregator=aggregator)
+    params = enc.init(jax.random.PRNGKey(0), feats, adjs)
+    out = jax.jit(enc.apply)(params, feats, adjs)
+    assert out.shape == (2, 8)
+    assert np.isfinite(np.asarray(out)).all()
