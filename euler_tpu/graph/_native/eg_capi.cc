@@ -137,10 +137,12 @@ void eg_get_top_k_neighbor(void* h, const uint64_t* ids, int n,
                                            out_ids, out_w, out_t);
 }
 
+// etypes_flat/etype_counts: per-step edge-type segments (walk_len segments).
 void eg_random_walk(void* h, const uint64_t* ids, int n,
-                    const int32_t* etypes, int net, int walk_len, float p,
-                    float q, uint64_t default_id, uint64_t* out) {
-  static_cast<Engine*>(h)->RandomWalk(ids, n, etypes, net, nullptr, 0,
+                    const int32_t* etypes_flat, const int32_t* etype_counts,
+                    int walk_len, float p, float q, uint64_t default_id,
+                    uint64_t* out) {
+  static_cast<Engine*>(h)->RandomWalk(ids, n, etypes_flat, etype_counts,
                                       walk_len, p, q, default_id, out);
 }
 

@@ -211,12 +211,13 @@ void Engine::GetTopKNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
   }
 }
 
-void Engine::RandomWalk(const uint64_t* ids, int n, const int32_t* etypes,
-                        int net, const int32_t* parent_etypes, int pnet,
-                        int walk_len, float p, float q, uint64_t default_id,
-                        uint64_t* out) const {
-  (void)parent_etypes;
-  (void)pnet;
+void Engine::RandomWalk(const uint64_t* ids, int n,
+                        const int32_t* etypes_flat,
+                        const int32_t* etype_counts, int walk_len, float p,
+                        float q, uint64_t default_id, uint64_t* out) const {
+  // Per-step edge-type segment offsets.
+  std::vector<int64_t> seg(static_cast<size_t>(walk_len) + 1, 0);
+  for (int s = 0; s < walk_len; ++s) seg[s + 1] = seg[s] + etype_counts[s];
   int64_t stride = walk_len + 1;
 #pragma omp parallel for schedule(dynamic, 16) if (n * walk_len > 512)
   for (int i = 0; i < n; ++i) {
@@ -228,8 +229,9 @@ void Engine::RandomWalk(const uint64_t* ids, int n, const int32_t* etypes,
     bool has_parent = false;
     for (int s = 1; s <= walk_len; ++s) {
       int64_t idx = store_.NodeIndex(cur);
-      uint64_t next = store_.BiasedNeighbor(idx, has_parent, parent, etypes,
-                                            net, p, q, default_id, rng);
+      uint64_t next = store_.BiasedNeighbor(
+          idx, has_parent, parent, etypes_flat + seg[s - 1],
+          static_cast<int>(seg[s] - seg[s - 1]), p, q, default_id, rng);
       row[s] = next;
       parent = cur;
       has_parent = true;

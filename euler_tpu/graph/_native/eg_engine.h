@@ -77,10 +77,13 @@ class Engine {
 
   // ---- walks ----
   // out: [n, walk_len+1], column 0 = start ids. Walks through missing nodes
-  // emit default_id for the rest of the walk.
-  void RandomWalk(const uint64_t* ids, int n, const int32_t* etypes, int net,
-                  const int32_t* parent_etypes, int pnet, int walk_len,
-                  float p, float q, uint64_t default_id, uint64_t* out) const;
+  // emit default_id for the rest of the walk. Each step s uses its own
+  // edge-type set (etypes_flat segmented by etype_counts, one segment per
+  // step) — heterogeneous metapath walks, matching the reference RandomWalk
+  // op's per-step edge_types inputs (tf_euler/ops/walk_ops.cc:71-100).
+  void RandomWalk(const uint64_t* ids, int n, const int32_t* etypes_flat,
+                  const int32_t* etype_counts, int walk_len, float p, float q,
+                  uint64_t default_id, uint64_t* out) const;
 
   // ---- features ----
   void GetDenseFeature(const uint64_t* ids, int n, const int32_t* fids,

@@ -235,16 +235,37 @@ class Graph:
 
     # ---- walks ----
     def random_walk(
-        self, ids, edge_types, walk_len: int, p: float = 1.0, q: float = 1.0,
-        default_node: int = -1,
+        self, ids, edge_types, walk_len: int = None, p: float = 1.0,
+        q: float = 1.0, default_node: int = -1,
     ) -> np.ndarray:
-        """[n, walk_len+1] int64 walks; column 0 is the start node."""
+        """[n, walk_len+1] int64 walks; column 0 is the start node.
+
+        edge_types is either a flat list (same types every step; walk_len
+        required) or a per-step list of lists defining a heterogeneous
+        metapath (walk_len inferred), e.g. [[0], [1], [0]].
+        """
         ids = _ids(ids)
-        et = _i32(edge_types)
+        if len(edge_types) > 0 and isinstance(
+            edge_types[0], (list, tuple, np.ndarray)
+        ):
+            steps = [_i32(e) for e in edge_types]
+            if walk_len is None:
+                walk_len = len(steps)
+            elif walk_len != len(steps):
+                raise ValueError("walk_len != len(edge_types metapath)")
+        else:
+            if walk_len is None:
+                raise ValueError("walk_len required with flat edge_types")
+            steps = [_i32(edge_types)] * walk_len
+        et_flat = (
+            np.concatenate(steps) if steps else np.zeros(0, np.int32)
+        )
+        et_counts = _i32([len(s) for s in steps])
         out = np.empty((len(ids), walk_len + 1), dtype=np.uint64)
         self._lib.eg_random_walk(
-            self._h, _ptr(ids, _U64P), len(ids), _ptr(et, _I32P), len(et),
-            walk_len, p, q, _default_u64(default_node), _ptr(out, _U64P),
+            self._h, _ptr(ids, _U64P), len(ids), _ptr(et_flat, _I32P),
+            _ptr(et_counts, _I32P), walk_len, p, q,
+            _default_u64(default_node), _ptr(out, _U64P),
         )
         return out.view(np.int64)
 

@@ -85,7 +85,7 @@ def lib() -> ctypes.CDLL:
     _sig(
         L.eg_random_walk,
         None,
-        [p, u64p, c.c_int, i32p, c.c_int, c.c_int, c.c_float, c.c_float,
+        [p, u64p, c.c_int, i32p, i32p, c.c_int, c.c_float, c.c_float,
          c.c_uint64, u64p],
     )
     _sig(

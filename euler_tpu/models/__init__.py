@@ -1,4 +1,29 @@
-from euler_tpu.models.base import Model, ModelOutput
-from euler_tpu.models.graphsage import GraphSage, SupervisedGraphSage
+"""Model zoo registry (reference tf_euler/python/models/__init__.py)."""
 
-__all__ = ["Model", "ModelOutput", "GraphSage", "SupervisedGraphSage"]
+from euler_tpu.models.base import Model, ModelOutput, ScalableStoreModel
+from euler_tpu.models.gat import GAT
+from euler_tpu.models.gcn import ScalableGCN, SupervisedGCN
+from euler_tpu.models.graphsage import (
+    GraphSage,
+    ScalableSage,
+    SupervisedGraphSage,
+)
+from euler_tpu.models.lasgnn import LasGNN
+from euler_tpu.models.lshne import LsHNE
+from euler_tpu.models.shallow import LINE, Node2Vec
+
+__all__ = [
+    "LasGNN",
+    "LsHNE",
+    "Model",
+    "ModelOutput",
+    "ScalableStoreModel",
+    "GAT",
+    "ScalableGCN",
+    "SupervisedGCN",
+    "GraphSage",
+    "ScalableSage",
+    "SupervisedGraphSage",
+    "LINE",
+    "Node2Vec",
+]

@@ -115,9 +115,37 @@ class Model:
     def sample_embed(self, graph, inputs) -> dict:
         return self.sample(graph, inputs)
 
+    def node_inputs(self, graph, ids: np.ndarray) -> dict:
+        """Shared host-side gather of one node set's ShallowEncoder inputs,
+        driven by the model's configured feature attributes (use_id /
+        feature_idx / feature_dim / sparse_feature_idx /
+        sparse_feature_max_ids / sparse_max_len / max_id)."""
+        from euler_tpu import ops
+
+        ids = np.asarray(ids).reshape(-1)
+        feats: dict = {}
+        if getattr(self, "use_id", False):
+            feats["ids"] = np.clip(ids, 0, self.max_id + 1).astype(np.int32)
+        if getattr(self, "feature_idx", -1) >= 0:
+            feats["dense"] = graph.get_dense_feature(
+                ids, [self.feature_idx], [self.feature_dim]
+            )
+        sparse_idx = getattr(self, "sparse_feature_idx", [])
+        if sparse_idx:
+            feats["sparse"] = ops.get_sparse_feature(
+                graph,
+                ids,
+                sparse_idx,
+                self.sparse_max_len,
+                default_values=[
+                    m + 1 for m in self.sparse_feature_max_ids
+                ],
+            )
+        return feats
+
     # ---- device state & steps ----
     def init_state(self, rng, graph, example_inputs, optimizer) -> dict:
-        batch = self.sample(graph, np.asarray(example_inputs))
+        batch = self.sample(graph, example_inputs)
         variables = self.module.init(rng, batch)
         params = variables["params"]
         return {"params": params, "opt_state": optimizer.init(params)}
@@ -160,5 +188,152 @@ class Model:
                 batch,
                 method=self.module.embed,
             )
+
+        return embed_step
+
+
+class ScalableStoreModel(Model):
+    """Shared training machinery for the Scalable{GCN,Sage} family
+    (reference encoders.py:218-519 + the gcn.py/graphsage.py session hooks).
+
+    Each step samples only the 1-hop neighborhood; deeper layers read stale
+    neighbor embeddings from per-layer stores. The reference splits the
+    bookkeeping across three TF session hooks and an auxiliary Adam; here it
+    all fuses into one jitted step:
+      1. read stale downstream grads at this batch's nodes, clear the rows
+      2. main update from d(loss)/d(params)
+      3. store-Adam update from d(store_loss)/d(params), where store_loss =
+         sum(node_emb * stale_grad)
+      4. scatter-add d(loss + store_loss)/d(store_read) at the neighbors
+      5. write fresh activations back to the stores
+    Requires: self.num_layers, self.dim, self.max_id,
+    self.store_learning_rate, self.store_init_maxval, and a module exposing
+    forward_train(batch, store_reads) -> (loss, metric, node_embeddings, emb)
+    with batch keys node_ids / neigh_ids.
+    """
+
+    def init_state(self, rng, graph, example_inputs, optimizer) -> dict:
+        batch = self.sample(graph, example_inputs)
+        store_reads = [
+            jnp.zeros((len(batch["neigh_ids"]), self.dim))
+            for _ in range(self.num_layers - 1)
+        ]
+        variables = self.module.init(rng, batch, store_reads)
+        params = variables["params"]
+        n_store = self.max_id + 2
+        k1 = jax.random.fold_in(rng, 1)
+        stores = [
+            jax.random.uniform(
+                jax.random.fold_in(k1, i),
+                (n_store, self.dim),
+                minval=0.0,
+                maxval=self.store_init_maxval,
+            )
+            for i in range(1, self.num_layers)
+        ]
+        grad_stores = [
+            jnp.zeros((n_store, self.dim)) for _ in range(1, self.num_layers)
+        ]
+        store_opt = optax.adam(self.store_learning_rate)
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "stores": stores,
+            "grad_stores": grad_stores,
+            "store_opt_state": store_opt.init(params),
+        }
+
+    def make_train_step(self, optimizer):
+        store_opt = optax.adam(self.store_learning_rate)
+        module = self.module
+        num_stores = self.num_layers - 1
+
+        def train_step(state, batch):
+            node_ids = batch["node_ids"]
+            neigh_ids = batch["neigh_ids"]
+            store_reads = [s[neigh_ids] for s in state["stores"]]
+            stale = [gs[node_ids] for gs in state["grad_stores"]]
+            grad_stores = [
+                gs.at[node_ids].set(jnp.zeros_like(s))
+                for gs, s in zip(state["grad_stores"], stale)
+            ]
+
+            def forward(params, reads):
+                return module.apply(
+                    {"params": params},
+                    batch,
+                    reads,
+                    method=module.forward_train,
+                )
+
+            def loss_fn(params, reads):
+                loss, metric, node_embeddings, _ = forward(params, reads)
+                return loss, (metric, node_embeddings)
+
+            (loss, (metric, node_embs)), (gp_main, gr_main) = (
+                jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)(
+                    state["params"], store_reads
+                )
+            )
+            updates, opt_state = optimizer.update(
+                gp_main, state["opt_state"], state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
+
+            if num_stores > 0:
+
+                def store_loss_fn(params, reads):
+                    _, _, node_embeddings, _ = forward(params, reads)
+                    return sum(
+                        jnp.sum(emb * jax.lax.stop_gradient(g))
+                        for emb, g in zip(node_embeddings, stale)
+                    )
+
+                gp_store, gr_store = jax.grad(
+                    store_loss_fn, argnums=(0, 1)
+                )(state["params"], store_reads)
+                supdates, store_opt_state = store_opt.update(
+                    gp_store, state["store_opt_state"], params
+                )
+                params = optax.apply_updates(params, supdates)
+                grad_stores = [
+                    gs.at[neigh_ids].add(gm + gss)
+                    for gs, gm, gss in zip(grad_stores, gr_main, gr_store)
+                ]
+            else:
+                store_opt_state = state["store_opt_state"]
+
+            stores = [
+                s.at[node_ids].set(jax.lax.stop_gradient(emb))
+                for s, emb in zip(state["stores"], node_embs)
+            ]
+            new_state = {
+                "params": params,
+                "opt_state": opt_state,
+                "stores": stores,
+                "grad_stores": grad_stores,
+                "store_opt_state": store_opt_state,
+            }
+            return new_state, loss, metric
+
+        return train_step
+
+    def make_eval_step(self):
+        module = self.module
+
+        def eval_step(state, batch):
+            store_reads = [s[batch["neigh_ids"]] for s in state["stores"]]
+            out = module.apply({"params": state["params"]}, batch, store_reads)
+            return out.loss, out.metric
+
+        return eval_step
+
+    def make_embed_step(self):
+        module = self.module
+
+        def embed_step(state, batch):
+            store_reads = [s[batch["neigh_ids"]] for s in state["stores"]]
+            out = module.apply({"params": state["params"]}, batch, store_reads)
+            return out.embedding
 
         return embed_step

@@ -1,0 +1,102 @@
+"""GAT supervised model.
+
+Reference equivalent: tf_euler/python/models/gat.py:25 + the AttEncoder
+(encoders.py:563-632). Host: sample nb_num neighbors + gather features into
+the [B, nb+1, F] sequence; device: all-pairs attention heads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import numpy as np
+
+from euler_tpu.models import base
+from euler_tpu.nn import metrics
+from euler_tpu.nn.encoders import AttEncoder
+
+
+class _GATModule(nn.Module):
+    head_num: int
+    hidden_dim: int
+    num_classes: int
+    sigmoid_loss: bool = True
+
+    def setup(self):
+        self.encoder = AttEncoder(
+            head_num=self.head_num,
+            hidden_dim=self.hidden_dim,
+            out_dim=self.num_classes,
+        )
+
+    def embed(self, batch):
+        return self.encoder(batch["seq"])
+
+    def __call__(self, batch):
+        # The reference AttEncoder's out_dim IS num_classes (logits).
+        logits = self.embed(batch)
+        labels = batch["labels"]
+        loss, predictions = base.supervised_decoder(
+            logits, labels, self.sigmoid_loss
+        )
+        return base.ModelOutput(
+            embedding=logits,
+            loss=loss,
+            metric_name="f1",
+            metric=metrics.f1_counts(labels, predictions),
+        )
+
+
+class GAT(base.Model):
+    metric_name = "f1"
+
+    def __init__(
+        self,
+        label_idx: int,
+        label_dim: int,
+        feature_idx: int,
+        feature_dim: int,
+        max_id: int = -1,
+        head_num: int = 1,
+        hidden_dim: int = 128,
+        nb_num: int = 5,
+        edge_type: int = 0,
+        num_classes: Optional[int] = None,
+        sigmoid_loss: bool = True,
+    ):
+        super().__init__()
+        self.label_idx = label_idx
+        self.label_dim = label_dim
+        self.feature_idx = feature_idx
+        self.feature_dim = feature_dim
+        self.max_id = max_id
+        self.nb_num = nb_num
+        self.edge_type = [edge_type] if np.isscalar(edge_type) else list(
+            edge_type
+        )
+        self.module = _GATModule(
+            head_num=head_num,
+            hidden_dim=hidden_dim,
+            num_classes=num_classes or label_dim,
+            sigmoid_loss=sigmoid_loss,
+        )
+
+    def sample(self, graph, inputs) -> dict:
+        roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        B = len(roots)
+        default = self.max_id + 1 if self.max_id >= 0 else -1
+        nbrs, _, _ = graph.sample_neighbor(
+            roots, self.edge_type, self.nb_num, default
+        )
+        node_feats = graph.get_dense_feature(
+            roots, [self.feature_idx], [self.feature_dim]
+        ).reshape(B, 1, self.feature_dim)
+        nbr_feats = graph.get_dense_feature(
+            nbrs.reshape(-1), [self.feature_idx], [self.feature_dim]
+        ).reshape(B, self.nb_num, self.feature_dim)
+        seq = np.concatenate([node_feats, nbr_feats], axis=1)
+        labels = graph.get_dense_feature(
+            roots, [self.label_idx], [self.label_dim]
+        )
+        return {"seq": seq, "labels": labels}

@@ -16,7 +16,11 @@ import numpy as np
 from euler_tpu import ops
 from euler_tpu.models import base
 from euler_tpu.nn import metrics
-from euler_tpu.nn.encoders import SageEncoder, ShallowEncoder
+from euler_tpu.nn.encoders import (
+    SageEncoder,
+    ScalableSageEncoder,
+    ShallowEncoder,
+)
 
 
 class _SupervisedSageModule(nn.Module):
@@ -115,34 +119,141 @@ class SupervisedGraphSage(base.Model):
             sparse_feature_max_ids=tuple(sparse_feature_max_ids),
         )
 
-    def _node_feats(self, graph, ids: np.ndarray) -> dict:
-        feats: dict = {}
-        if self.use_id:
-            feats["ids"] = ids.astype(np.int32)
-        if self.feature_idx >= 0:
-            feats["dense"] = graph.get_dense_feature(
-                ids, [self.feature_idx], [self.feature_dim]
-            )
-        if self.sparse_feature_idx:
-            feats["sparse"] = ops.get_sparse_feature(
-                graph,
-                ids,
-                self.sparse_feature_idx,
-                self.sparse_max_len,
-                default_values=[m + 1 for m in self.sparse_feature_max_ids],
-            )
-        return feats
-
     def sample(self, graph, inputs) -> dict:
         inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
         ids_per_hop, _, _ = graph.sample_fanout(
             inputs, self.metapath, self.fanouts, self.default_node
         )
-        hops = [self._node_feats(graph, ids) for ids in ids_per_hop]
+        hops = [self.node_inputs(graph, ids) for ids in ids_per_hop]
         labels = graph.get_dense_feature(
             inputs, [self.label_idx], [self.label_dim]
         )
         return {"hops": hops, "labels": labels}
+
+
+class _ScalableSageModule(nn.Module):
+    """Training-mode ScalableSage forward: 1-hop fanout + per-layer store
+    reads (reference encoders.py:449-483)."""
+
+    fanout: int
+    num_layers: int
+    dim: int
+    num_classes: int
+    aggregator: str = "mean"
+    concat: bool = False
+    sigmoid_loss: bool = True
+    feature_dim: int = 0
+    max_id: int = -1
+    embedding_dim: int = 16
+
+    def setup(self):
+        self.node_encoder = ShallowEncoder(
+            feature_dim=self.feature_dim,
+            max_id=self.max_id,
+            embedding_dim=self.embedding_dim,
+        )
+        self.encoder = ScalableSageEncoder(
+            fanout=self.fanout,
+            num_layers=self.num_layers,
+            dim=self.dim,
+            aggregator=self.aggregator,
+            concat=self.concat,
+        )
+        self.predict = nn.Dense(self.num_classes)
+
+    def forward_train(self, batch, store_reads):
+        node_feat = self.node_encoder(batch["node_feats"])
+        neigh_feat = self.node_encoder(batch["neigh_feats"])
+        emb, node_embeddings = self.encoder(node_feat, neigh_feat, store_reads)
+        logits = self.predict(emb)
+        labels = batch["labels"]
+        loss, predictions = base.supervised_decoder(
+            logits, labels, self.sigmoid_loss
+        )
+        return (
+            loss,
+            metrics.f1_counts(labels, predictions),
+            node_embeddings,
+            emb,
+        )
+
+    def __call__(self, batch, store_reads):
+        loss, f1c, _, emb = self.forward_train(batch, store_reads)
+        return base.ModelOutput(
+            embedding=emb, loss=loss, metric_name="f1", metric=f1c
+        )
+
+
+class ScalableSage(base.ScalableStoreModel):
+    """ScalableSage (reference models/graphsage.py:81 + encoders.py:404-519):
+    GraphSAGE whose receptive field is capped at one sampled hop per step by
+    per-layer historical-embedding stores. Store machinery inherited from
+    base.ScalableStoreModel."""
+
+    metric_name = "f1"
+
+    def __init__(
+        self,
+        label_idx: int,
+        label_dim: int,
+        edge_type: Sequence[int],
+        fanout: int,
+        num_layers: int,
+        dim: int,
+        max_id: int,
+        aggregator: str = "mean",
+        concat: bool = False,
+        feature_idx: int = -1,
+        feature_dim: int = 0,
+        use_id: bool = False,
+        embedding_dim: int = 16,
+        store_learning_rate: float = 0.001,
+        store_init_maxval: float = 0.05,
+        num_classes: Optional[int] = None,
+        sigmoid_loss: bool = True,
+    ):
+        super().__init__()
+        self.label_idx = label_idx
+        self.label_dim = label_dim
+        self.edge_type = list(edge_type)
+        self.fanout = fanout
+        self.num_layers = num_layers
+        self.dim = dim
+        self.max_id = max_id
+        self.feature_idx = feature_idx
+        self.feature_dim = feature_dim
+        self.use_id = use_id
+        self.store_learning_rate = store_learning_rate
+        self.store_init_maxval = store_init_maxval
+        self.module = _ScalableSageModule(
+            fanout=fanout,
+            num_layers=num_layers,
+            dim=dim,
+            num_classes=num_classes or label_dim,
+            aggregator=aggregator,
+            concat=concat,
+            sigmoid_loss=sigmoid_loss,
+            feature_dim=feature_dim if feature_idx >= 0 else 0,
+            max_id=max_id if use_id else -1,
+            embedding_dim=embedding_dim,
+        )
+
+    def sample(self, graph, inputs) -> dict:
+        roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        ids_per_hop, _, _ = graph.sample_fanout(
+            roots, [self.edge_type], [self.fanout], self.max_id + 1
+        )
+        neigh = ids_per_hop[1]
+        labels = graph.get_dense_feature(
+            roots, [self.label_idx], [self.label_dim]
+        )
+        return {
+            "node_feats": self.node_inputs(graph, roots),
+            "neigh_feats": self.node_inputs(graph, neigh),
+            "node_ids": np.clip(roots, 0, self.max_id + 1),
+            "neigh_ids": np.clip(neigh, 0, self.max_id + 1),
+            "labels": labels,
+        }
 
 
 class _UnsupervisedSageModule(nn.Module):
@@ -260,17 +371,7 @@ class GraphSage(base.Model):
         ids_per_hop, _, _ = graph.sample_fanout(
             ids, self.metapath, self.fanouts, self.default_node
         )
-        out = []
-        for hop_ids in ids_per_hop:
-            feats = {}
-            if self.use_id:
-                feats["ids"] = hop_ids.astype(np.int32)
-            if self.feature_idx >= 0:
-                feats["dense"] = graph.get_dense_feature(
-                    hop_ids, [self.feature_idx], [self.feature_dim]
-                )
-            out.append(feats)
-        return out
+        return [self.node_inputs(graph, hop_ids) for hop_ids in ids_per_hop]
 
     def sample(self, graph, inputs) -> dict:
         inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)

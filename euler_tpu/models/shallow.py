@@ -1,0 +1,208 @@
+"""Shallow network-embedding models: LINE and Node2Vec.
+
+Reference equivalents: tf_euler/python/models/line.py:26 (first/second
+order) and node2vec.py:26 (walk -> gen_pair -> shallow encoders). Walks and
+pair generation run on the host (one native call for the whole walk chain,
+vs the reference's walk_len sequential async RPCs,
+tf_euler/kernels/random_walk_op.cc:31-140); the device sees fixed-shape
+(src, pos, negs) node-input batches.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import numpy as np
+
+from euler_tpu import ops
+from euler_tpu.models import base
+from euler_tpu.nn.encoders import ShallowEncoder
+
+
+class _ShallowUnsupModule(nn.Module):
+    dim: int
+    feature_dim: int = 0
+    max_id: int = -1
+    embedding_dim: int = 16
+    sparse_feature_max_ids: Sequence[int] = ()
+    combiner: str = "add"
+    xent_loss: bool = False
+    num_negs: int = 5
+    share_context: bool = False  # LINE first-order shares the encoder
+
+    def setup(self):
+        kw = dict(
+            dim=self.dim,
+            feature_dim=self.feature_dim,
+            max_id=self.max_id,
+            embedding_dim=self.embedding_dim,
+            sparse_feature_max_ids=tuple(self.sparse_feature_max_ids),
+            combiner=self.combiner,
+        )
+        self.target = ShallowEncoder(**kw)
+        if not self.share_context:
+            self.context = ShallowEncoder(**kw)
+
+    def _context(self, x):
+        return self.target(x) if self.share_context else self.context(x)
+
+    def embed(self, batch):
+        return self.target(batch["src"])
+
+    def __call__(self, batch):
+        emb = self.target(batch["src"])  # [B, d]
+        emb_pos = self._context(batch["pos"])  # [B, d]
+        emb_negs = self._context(batch["negs"])  # [B*negs, d]
+        B = emb.shape[0]
+        loss, mrr = base.unsupervised_decoder(
+            emb.reshape(B, 1, -1),
+            emb_pos.reshape(B, 1, -1),
+            emb_negs.reshape(B, self.num_negs, -1),
+            self.xent_loss,
+        )
+        return base.ModelOutput(
+            embedding=emb, loss=loss, metric_name="mrr", metric=mrr
+        )
+
+
+class _ShallowUnsupervised(base.Model):
+    """Shared host plumbing for models whose batch is (src, pos, negs)
+    node-input dicts."""
+
+    metric_name = "mrr"
+
+    def __init__(
+        self,
+        node_type: int,
+        max_id: int,
+        feature_idx: int = -1,
+        feature_dim: int = 0,
+        use_id: bool = True,
+        sparse_feature_idx: Sequence[int] = (),
+        sparse_feature_max_ids: Sequence[int] = (),
+        sparse_max_len: int = 16,
+        num_negs: int = 5,
+    ):
+        super().__init__()
+        self.node_type = node_type
+        self.max_id = max_id
+        self.feature_idx = feature_idx
+        self.feature_dim = feature_dim
+        self.use_id = use_id
+        self.sparse_feature_idx = list(sparse_feature_idx)
+        self.sparse_feature_max_ids = list(sparse_feature_max_ids)
+        self.sparse_max_len = sparse_max_len
+        self.num_negs = num_negs
+
+    def _pack(self, graph, src, pos, negs) -> dict:
+        return {
+            "src": self.node_inputs(graph, src),
+            "pos": self.node_inputs(graph, pos),
+            "negs": self.node_inputs(graph, negs),
+        }
+
+    def sample_embed(self, graph, inputs) -> dict:
+        ids = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        return {"src": self.node_inputs(graph, ids)}
+
+
+class LINE(_ShallowUnsupervised):
+    """LINE (reference models/line.py:26): positives are direct neighbors;
+    order 1 shares the target/context encoder, order 2 uses two towers."""
+
+    def __init__(
+        self,
+        node_type: int,
+        edge_type: Sequence[int],
+        max_id: int,
+        dim: int,
+        order: int = 1,
+        combiner: str = "add",
+        xent_loss: bool = False,
+        embedding_dim: int = 16,
+        **kwargs,
+    ):
+        super().__init__(node_type, max_id, **kwargs)
+        if order not in (1, 2, "first", "second"):
+            raise ValueError(f"LINE order must be 1 or 2, got {order}")
+        self.edge_type = list(edge_type)
+        self.module = _ShallowUnsupModule(
+            dim=dim,
+            feature_dim=self.feature_dim if self.feature_idx >= 0 else 0,
+            max_id=max_id if self.use_id else -1,
+            embedding_dim=embedding_dim,
+            sparse_feature_max_ids=tuple(self.sparse_feature_max_ids),
+            combiner=combiner,
+            xent_loss=xent_loss,
+            num_negs=self.num_negs,
+            share_context=order in (1, "first"),
+        )
+
+    def sample(self, graph, inputs) -> dict:
+        src = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        pos, _, _ = graph.sample_neighbor(
+            src, self.edge_type, 1, self.max_id + 1
+        )
+        negs = graph.sample_node(len(src) * self.num_negs, self.node_type)
+        return self._pack(graph, src, pos.reshape(-1), negs)
+
+
+class Node2Vec(_ShallowUnsupervised):
+    """Node2Vec (reference models/node2vec.py:26): biased walks ->
+    skip-gram pairs -> shallow encoders. batch_size_ratio is the pair count
+    per root (the effective batch multiplier, reference node2vec.py:44-46).
+    """
+
+    def __init__(
+        self,
+        node_type: int,
+        edge_type: Sequence[int],
+        max_id: int,
+        dim: int,
+        walk_len: int = 3,
+        walk_p: float = 1.0,
+        walk_q: float = 1.0,
+        left_win_size: int = 1,
+        right_win_size: int = 1,
+        combiner: str = "add",
+        xent_loss: bool = False,
+        embedding_dim: int = 16,
+        **kwargs,
+    ):
+        super().__init__(node_type, max_id, **kwargs)
+        self.edge_type = list(edge_type)
+        self.walk_len = walk_len
+        self.walk_p = walk_p
+        self.walk_q = walk_q
+        self.left_win_size = left_win_size
+        self.right_win_size = right_win_size
+        self.batch_size_ratio = ops.walk.pair_count(
+            walk_len + 1, left_win_size, right_win_size
+        )
+        self.module = _ShallowUnsupModule(
+            dim=dim,
+            feature_dim=self.feature_dim if self.feature_idx >= 0 else 0,
+            max_id=max_id if self.use_id else -1,
+            embedding_dim=embedding_dim,
+            sparse_feature_max_ids=tuple(self.sparse_feature_max_ids),
+            combiner=combiner,
+            xent_loss=xent_loss,
+            num_negs=self.num_negs,
+        )
+
+    def sample(self, graph, inputs) -> dict:
+        roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        paths = graph.random_walk(
+            roots,
+            self.edge_type,
+            self.walk_len,
+            p=self.walk_p,
+            q=self.walk_q,
+            default_node=self.max_id + 1,
+        )
+        pairs = ops.gen_pair(paths, self.left_win_size, self.right_win_size)
+        flat = pairs.reshape(-1, 2)  # [B*num_pairs, 2]
+        src, pos = flat[:, 0], flat[:, 1]
+        negs = graph.sample_node(len(src) * self.num_negs, self.node_type)
+        return self._pack(graph, src, pos, negs)

@@ -144,6 +144,49 @@ class GCNEncoder(nn.Module):
         return hidden[0]
 
 
+class _AttHead(nn.Module):
+    """One all-pairs attention head over [B, n, F]
+    (reference encoders.py:587-598 att_head)."""
+
+    out_size: int
+
+    @nn.compact
+    def __call__(self, seq, activation=nn.elu):
+        seq_fts = nn.Dense(self.out_size, use_bias=False)(seq)  # [B, n, out]
+        f1 = nn.Dense(1)(seq_fts)  # [B, n, 1]
+        f2 = nn.Dense(1)(seq_fts)  # [B, n, 1]
+        logits = f1 + jnp.swapaxes(f2, 1, 2)  # [B, n, n]
+        coefs = nn.softmax(nn.leaky_relu(logits), axis=-1)
+        vals = jnp.einsum("bij,bjd->bid", coefs, seq_fts)
+        bias = self.param("bias", nn.initializers.zeros, (self.out_size,))
+        out = vals + bias
+        if activation is not None:
+            out = activation(out)
+        return out
+
+
+class AttEncoder(nn.Module):
+    """GAT-style attention over a sampled neighborhood
+    (reference encoders.py:563-632): input is the [B, nb+1, F] feature
+    sequence (self node at position 0 + nb sampled neighbors); two rounds of
+    attention heads; output is position 0's features. All-pairs softmax
+    attention on a tiny nb+1 axis — dense matmuls, MXU-friendly."""
+
+    head_num: int = 1
+    hidden_dim: int = 256
+    out_dim: int = 1
+
+    @nn.compact
+    def __call__(self, seq):
+        hidden = [
+            _AttHead(self.hidden_dim)(seq) for _ in range(self.head_num)
+        ]
+        h1 = jnp.concatenate(hidden, axis=-1)
+        outs = [_AttHead(self.out_dim)(h1) for _ in range(self.head_num)]
+        out = sum(outs) / self.head_num  # [B, n, out_dim]
+        return out[:, 0, :]
+
+
 class ScalableSageEncoder(nn.Module):
     """GraphSAGE with historical-embedding stores: each layer >0 reads its
     neighbor embeddings from a store instead of recursive sampling, capping

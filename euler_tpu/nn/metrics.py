@@ -7,6 +7,7 @@ an explicit counts pytree the training loop threads through steps.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -40,6 +41,35 @@ def mrr(logits, neg_logits):
     """
     rank = 1.0 + jnp.sum(neg_logits >= logits, axis=-1)
     return jnp.mean(1.0 / rank)
+
+
+AUC_BINS = 200
+
+
+def auc_counts(labels, scores, nbins: int = AUC_BINS):
+    """Per-batch [2, nbins] score histograms (row 0 = negatives, row 1 =
+    positives) for streaming AUC (the JAX analog of tf.metrics.auc's
+    bucketed accumulators, used by the reference LasGNN,
+    models/lasgnn.py:153)."""
+    labels = (labels.reshape(-1) != 0).astype(jnp.float32)
+    scores = jnp.clip(scores.reshape(-1), 0.0, 1.0 - 1e-7)
+    bins = (scores * nbins).astype(jnp.int32)
+    onehot = jax.nn.one_hot(bins, nbins)
+    pos = jnp.sum(onehot * labels[:, None], axis=0)
+    neg = jnp.sum(onehot * (1.0 - labels)[:, None], axis=0)
+    return jnp.stack([neg, pos])
+
+
+def auc_from_counts(counts) -> float:
+    """Trapezoidal AUC from accumulated [2, nbins] histograms."""
+    neg, pos = np.asarray(counts, dtype=np.float64)
+    p_tot, n_tot = pos.sum(), neg.sum()
+    if p_tot == 0 or n_tot == 0:
+        return 0.5
+    # For each positive bin b: negatives strictly below + half of ties.
+    neg_below = np.concatenate([[0.0], np.cumsum(neg)[:-1]])
+    wins = np.sum(pos * (neg_below + 0.5 * neg))
+    return float(wins / (p_tot * n_tot))
 
 
 def accuracy(labels, predictions):

@@ -44,18 +44,24 @@ def get_optimizer(name: str, lr: float):
 def _metric_value(name: str, acc) -> float:
     if name == "f1":
         return metrics_lib.f1_from_counts(acc)
+    if name == "auc":
+        return metrics_lib.auc_from_counts(acc)
     return float(acc[0] / max(acc[1], 1))  # running mean
 
 
 def _metric_accumulate(name: str, acc, value):
     value = np.asarray(value)
-    if name == "f1":
+    if name in ("f1", "auc"):
         return acc + value
     return np.array([acc[0] + float(value), acc[1] + 1.0])
 
 
 def _metric_zero(name: str):
-    return np.zeros(3) if name == "f1" else np.zeros(2)
+    if name == "f1":
+        return np.zeros(3)
+    if name == "auc":
+        return np.zeros((2, metrics_lib.AUC_BINS))
+    return np.zeros(2)
 
 
 def train(
