@@ -1,4 +1,5 @@
 from euler_tpu.graph.graph import Graph
 from euler_tpu.graph.convert import convert, convert_dicts
+from euler_tpu.graph.service import GraphService
 
-__all__ = ["Graph", "convert", "convert_dicts"]
+__all__ = ["Graph", "GraphService", "convert", "convert_dicts"]

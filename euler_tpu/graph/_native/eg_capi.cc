@@ -11,24 +11,32 @@
 #include <vector>
 
 #include "eg_engine.h"
+#include "eg_remote.h"
+#include "eg_service.h"
 
 using eg::EGResult;
 using eg::Engine;
+using eg::GraphAPI;
+using eg::RemoteGraph;
+using eg::Service;
 
 namespace {
 thread_local std::string g_last_error;
-}
+
+inline GraphAPI* API(void* h) { return static_cast<GraphAPI*>(h); }
+inline Engine* Local(void* h) { return static_cast<Engine*>(API(h)); }
+}  // namespace
 
 extern "C" {
 
 const char* eg_last_error() { return g_last_error.c_str(); }
 
-void* eg_create() { return new Engine(); }
+void* eg_create() { return static_cast<GraphAPI*>(new Engine()); }
 
-void eg_destroy(void* h) { delete static_cast<Engine*>(h); }
+void eg_destroy(void* h) { delete API(h); }
 
 int eg_load(void* h, const char* dir, int shard_idx, int shard_num) {
-  auto* e = static_cast<Engine*>(h);
+  auto* e = Local(h);
   if (!e->Load(dir, shard_idx, shard_num)) {
     g_last_error = e->error();
     return -1;
@@ -37,7 +45,7 @@ int eg_load(void* h, const char* dir, int shard_idx, int shard_num) {
 }
 
 int eg_load_files(void* h, const char** files, int nfiles) {
-  auto* e = static_cast<Engine*>(h);
+  auto* e = Local(h);
   std::vector<std::string> fs(files, files + nfiles);
   if (!e->LoadFiles(std::move(fs))) {
     g_last_error = e->error();
@@ -48,66 +56,84 @@ int eg_load_files(void* h, const char** files, int nfiles) {
 
 void eg_seed(uint64_t seed) { eg::SeedThreadRng(seed); }
 
+// ---- remote mode (Graph::NewGraph(mode=Remote) equivalent,
+// reference euler/client/graph.cc:157-185) ----
+// Config: "registry=<dir>" or "shards=h:p|h:p,..." (+ retries/timeout_ms/
+// quarantine_ms). Returns a handle usable with every query function below,
+// or nullptr (see eg_last_error).
+void* eg_remote_create(const char* config) {
+  auto* g = new RemoteGraph();
+  if (!g->Init(config ? config : "")) {
+    g_last_error = g->error();
+    delete g;
+    return nullptr;
+  }
+  return static_cast<GraphAPI*>(g);
+}
+
+int eg_remote_shards(void* h) {
+  return static_cast<RemoteGraph*>(API(h))->num_shards();
+}
+int eg_remote_partitions(void* h) {
+  return static_cast<RemoteGraph*>(API(h))->num_partitions();
+}
+
+// ---- graph service (StartService equivalent,
+// reference euler/service/python_api.cc:26-52) ----
+void* eg_service_start(const char* data_dir, int shard_idx, int shard_num,
+                       const char* host, int port, const char* registry_dir) {
+  auto* s = new Service();
+  if (!s->Start(data_dir, shard_idx, shard_num, host ? host : "",
+                port, registry_dir ? registry_dir : "")) {
+    g_last_error = s->error();
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int eg_service_port(void* s) { return static_cast<Service*>(s)->port(); }
+
+void eg_service_stop(void* s) { delete static_cast<Service*>(s); }
+
 // ---- introspection ----
-int64_t eg_num_nodes(void* h) {
-  return static_cast<int64_t>(static_cast<Engine*>(h)->store().num_nodes());
-}
-int64_t eg_num_edges(void* h) {
-  return static_cast<int64_t>(static_cast<Engine*>(h)->store().num_edges());
-}
-int32_t eg_node_type_num(void* h) {
-  return static_cast<Engine*>(h)->store().node_type_num();
-}
-int32_t eg_edge_type_num(void* h) {
-  return static_cast<Engine*>(h)->store().edge_type_num();
-}
+int64_t eg_num_nodes(void* h) { return API(h)->NumNodes(); }
+int64_t eg_num_edges(void* h) { return API(h)->NumEdges(); }
+int32_t eg_node_type_num(void* h) { return API(h)->NodeTypeNum(); }
+int32_t eg_edge_type_num(void* h) { return API(h)->EdgeTypeNum(); }
 // kind: 0=node u64, 1=node f32, 2=node binary, 3=edge u64, 4=edge f32,
 // 5=edge binary.
-int32_t eg_feature_num(void* h, int kind) {
-  const auto& s = static_cast<Engine*>(h)->store();
-  switch (kind) {
-    case 0: return s.nf_u64_num();
-    case 1: return s.nf_f32_num();
-    case 2: return s.nf_bin_num();
-    case 3: return s.ef_u64_num();
-    case 4: return s.ef_f32_num();
-    case 5: return s.ef_bin_num();
-    default: return -1;
-  }
-}
+int32_t eg_feature_num(void* h, int kind) { return API(h)->FeatureNum(kind); }
 // Per-type weight sums for cross-shard weighted sampling; out has
 // node_type_num (kind 0) or edge_type_num (kind 1) floats.
 void eg_type_weight_sums(void* h, int kind, float* out) {
-  const auto& s = static_cast<Engine*>(h)->store();
-  const auto& v =
-      kind == 0 ? s.node_type_weight_sums() : s.edge_type_weight_sums();
-  std::memcpy(out, v.data(), v.size() * sizeof(float));
+  API(h)->TypeWeightSums(kind, out);
 }
 
 // ---- sampling ----
 void eg_sample_node(void* h, int count, int32_t type, uint64_t* out) {
-  static_cast<Engine*>(h)->SampleNode(count, type, out);
+  API(h)->SampleNode(count, type, out);
 }
 
 void eg_sample_edge(void* h, int count, int32_t type, uint64_t* out_src,
                     uint64_t* out_dst, int32_t* out_type) {
-  static_cast<Engine*>(h)->SampleEdge(count, type, out_src, out_dst, out_type);
+  API(h)->SampleEdge(count, type, out_src, out_dst, out_type);
 }
 
 void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
                              uint64_t* out) {
-  static_cast<Engine*>(h)->SampleNodeWithSrc(src, n, count, out);
+  API(h)->SampleNodeWithSrc(src, n, count, out);
 }
 
 void eg_get_node_type(void* h, const uint64_t* ids, int n, int32_t* out) {
-  static_cast<Engine*>(h)->GetNodeType(ids, n, out);
+  API(h)->GetNodeType(ids, n, out);
 }
 
 void eg_sample_neighbor(void* h, const uint64_t* ids, int n,
                         const int32_t* etypes, int net, int count,
                         uint64_t default_id, uint64_t* out_ids, float* out_w,
                         int32_t* out_t) {
-  static_cast<Engine*>(h)->SampleNeighbor(ids, n, etypes, net, count,
+  API(h)->SampleNeighbor(ids, n, etypes, net, count,
                                           default_id, out_ids, out_w, out_t);
 }
 
@@ -118,14 +144,14 @@ void eg_sample_fanout(void* h, const uint64_t* ids, int n,
                       const int32_t* etypes_flat, const int32_t* etype_counts,
                       const int32_t* counts, int nhops, uint64_t default_id,
                       uint64_t** out_ids, float** out_w, int32_t** out_t) {
-  static_cast<Engine*>(h)->SampleFanout(ids, n, etypes_flat, etype_counts,
+  API(h)->SampleFanout(ids, n, etypes_flat, etype_counts,
                                         counts, nhops, default_id, out_ids,
                                         out_w, out_t);
 }
 
 void* eg_get_full_neighbor(void* h, const uint64_t* ids, int n,
                            const int32_t* etypes, int net, int sorted) {
-  return static_cast<Engine*>(h)->GetFullNeighbor(ids, n, etypes, net,
+  return API(h)->GetFullNeighbor(ids, n, etypes, net,
                                                   sorted != 0);
 }
 
@@ -133,7 +159,7 @@ void eg_get_top_k_neighbor(void* h, const uint64_t* ids, int n,
                            const int32_t* etypes, int net, int k,
                            uint64_t default_id, uint64_t* out_ids,
                            float* out_w, int32_t* out_t) {
-  static_cast<Engine*>(h)->GetTopKNeighbor(ids, n, etypes, net, k, default_id,
+  API(h)->GetTopKNeighbor(ids, n, etypes, net, k, default_id,
                                            out_ids, out_w, out_t);
 }
 
@@ -142,7 +168,7 @@ void eg_random_walk(void* h, const uint64_t* ids, int n,
                     const int32_t* etypes_flat, const int32_t* etype_counts,
                     int walk_len, float p, float q, uint64_t default_id,
                     uint64_t* out) {
-  static_cast<Engine*>(h)->RandomWalk(ids, n, etypes_flat, etype_counts,
+  API(h)->RandomWalk(ids, n, etypes_flat, etype_counts,
                                       walk_len, p, q, default_id, out);
 }
 
@@ -150,38 +176,38 @@ void eg_random_walk(void* h, const uint64_t* ids, int n,
 void eg_get_dense_feature(void* h, const uint64_t* ids, int n,
                           const int32_t* fids, const int32_t* dims, int nf,
                           float* out) {
-  static_cast<Engine*>(h)->GetDenseFeature(ids, n, fids, dims, nf, out);
+  API(h)->GetDenseFeature(ids, n, fids, dims, nf, out);
 }
 
 void eg_get_edge_dense_feature(void* h, const uint64_t* src,
                                const uint64_t* dst, const int32_t* types,
                                int n, const int32_t* fids,
                                const int32_t* dims, int nf, float* out) {
-  static_cast<Engine*>(h)->GetEdgeDenseFeature(src, dst, types, n, fids, dims,
+  API(h)->GetEdgeDenseFeature(src, dst, types, n, fids, dims,
                                                nf, out);
 }
 
 void* eg_get_sparse_feature(void* h, const uint64_t* ids, int n,
                             const int32_t* fids, int nf) {
-  return static_cast<Engine*>(h)->GetSparseFeature(ids, n, fids, nf);
+  return API(h)->GetSparseFeature(ids, n, fids, nf);
 }
 
 void* eg_get_edge_sparse_feature(void* h, const uint64_t* src,
                                  const uint64_t* dst, const int32_t* types,
                                  int n, const int32_t* fids, int nf) {
-  return static_cast<Engine*>(h)->GetEdgeSparseFeature(src, dst, types, n,
+  return API(h)->GetEdgeSparseFeature(src, dst, types, n,
                                                        fids, nf);
 }
 
 void* eg_get_binary_feature(void* h, const uint64_t* ids, int n,
                             const int32_t* fids, int nf) {
-  return static_cast<Engine*>(h)->GetBinaryFeature(ids, n, fids, nf);
+  return API(h)->GetBinaryFeature(ids, n, fids, nf);
 }
 
 void* eg_get_edge_binary_feature(void* h, const uint64_t* src,
                                  const uint64_t* dst, const int32_t* types,
                                  int n, const int32_t* fids, int nf) {
-  return static_cast<Engine*>(h)->GetEdgeBinaryFeature(src, dst, types, n,
+  return API(h)->GetEdgeBinaryFeature(src, dst, types, n,
                                                        fids, nf);
 }
 

@@ -11,11 +11,13 @@
 #ifndef EG_ENGINE_H_
 #define EG_ENGINE_H_
 
+#include <algorithm>
 #include <cstdint>
 #include <memory>
 #include <string>
 #include <vector>
 
+#include "eg_api.h"
 #include "eg_graph.h"
 
 namespace eg {
@@ -29,7 +31,7 @@ struct EGResult {
   std::vector<std::string> bytes;
 };
 
-class Engine {
+class Engine : public GraphAPI {
  public:
   // Load shard `shard_idx` of `shard_num` from a directory of partition
   // files named *_<p>.dat: the shard owns partitions p ≡ shard_idx (mod
@@ -40,6 +42,32 @@ class Engine {
   const std::string& error() const { return error_; }
 
   const GraphStore& store() const { return store_; }
+
+  // ---- introspection (GraphAPI) ----
+  int64_t NumNodes() const override {
+    return static_cast<int64_t>(store_.num_nodes());
+  }
+  int64_t NumEdges() const override {
+    return static_cast<int64_t>(store_.num_edges());
+  }
+  int32_t NodeTypeNum() const override { return store_.node_type_num(); }
+  int32_t EdgeTypeNum() const override { return store_.edge_type_num(); }
+  int32_t FeatureNum(int kind) const override {
+    switch (kind) {
+      case 0: return store_.nf_u64_num();
+      case 1: return store_.nf_f32_num();
+      case 2: return store_.nf_bin_num();
+      case 3: return store_.ef_u64_num();
+      case 4: return store_.ef_f32_num();
+      case 5: return store_.ef_bin_num();
+      default: return -1;
+    }
+  }
+  void TypeWeightSums(int kind, float* out) const override {
+    const auto& v = kind == 0 ? store_.node_type_weight_sums()
+                              : store_.edge_type_weight_sums();
+    std::copy(v.begin(), v.end(), out);
+  }
 
   // ---- global sampling ----
   void SampleNode(int count, int32_t type, uint64_t* out) const;

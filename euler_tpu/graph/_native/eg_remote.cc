@@ -1,0 +1,945 @@
+#include "eg_remote.h"
+
+#include <dirent.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <thread>
+
+namespace eg {
+
+namespace {
+
+int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Semicolon k=v parser — the string config form shared with the reference
+// (reference euler/client/graph_config.cc:33-56, create_graph.cc:50-60).
+std::map<std::string, std::string> ParseConfig(const std::string& s) {
+  std::map<std::string, std::string> out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, ';')) {
+    size_t eq = item.find('=');
+    if (eq == std::string::npos) continue;
+    out[item.substr(0, eq)] = item.substr(eq + 1);
+  }
+  return out;
+}
+
+bool ParseHostPort(const std::string& s, std::string* host, int* port) {
+  size_t c = s.rfind(':');
+  if (c == std::string::npos) return false;
+  *host = s.substr(0, c);
+  *port = std::atoi(s.c_str() + c + 1);
+  return *port > 0;
+}
+
+// Decode an EGResult encoded by the service (see WriteResult in
+// eg_service.cc).
+bool ReadResult(WireReader* r, EGResult* out) {
+  int32_t n = r->I32();
+  out->u64.resize(std::max(n, 0));
+  for (auto& v : out->u64) r->Vec(&v);
+  n = r->I32();
+  out->f32.resize(std::max(n, 0));
+  for (auto& v : out->f32) r->Vec(&v);
+  n = r->I32();
+  out->i32.resize(std::max(n, 0));
+  for (auto& v : out->i32) r->Vec(&v);
+  n = r->I32();
+  out->bytes.resize(std::max(n, 0));
+  for (auto& s : out->bytes) s = r->Str();
+  return r->ok();
+}
+
+}  // namespace
+
+// ---------------- ConnPool ----------------
+
+void ConnPool::AddReplica(const std::string& host, int port) {
+  auto r = std::make_unique<Replica>();
+  r->host = host;
+  r->port = port;
+  replicas_.push_back(std::move(r));
+}
+
+ConnPool::~ConnPool() {
+  for (auto& r : replicas_) {
+    std::lock_guard<std::mutex> l(r->mu);
+    for (int fd : r->idle) ::close(fd);
+  }
+}
+
+bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
+                    int timeout_ms, int quarantine_ms) const {
+  if (replicas_.empty()) return false;
+  int64_t now = NowMs();
+  for (int attempt = 0; attempt <= retries; ++attempt) {
+    // Round-robin replica choice skipping quarantined hosts; if every host
+    // is quarantined, use the nominal one anyway (matches the reference's
+    // bad-host re-admission behavior, rpc_manager.cc:64).
+    size_t start = rr_.fetch_add(1) % replicas_.size();
+    Replica* rep = replicas_[start].get();
+    for (size_t k = 0; k < replicas_.size(); ++k) {
+      Replica* cand = replicas_[(start + k) % replicas_.size()].get();
+      if (cand->bad_until_ms.load(std::memory_order_relaxed) <= now) {
+        rep = cand;
+        break;
+      }
+    }
+    int fd = -1;
+    {
+      std::lock_guard<std::mutex> l(rep->mu);
+      if (!rep->idle.empty()) {
+        fd = rep->idle.back();
+        rep->idle.pop_back();
+      }
+    }
+    if (fd < 0) fd = DialTcp(rep->host, rep->port, timeout_ms);
+    if (fd < 0) {
+      rep->bad_until_ms.store(now + quarantine_ms, std::memory_order_relaxed);
+      continue;
+    }
+    if (SendFrame(fd, req) && RecvFrame(fd, reply)) {
+      std::lock_guard<std::mutex> l(rep->mu);
+      rep->idle.push_back(fd);
+      return true;
+    }
+    ::close(fd);
+    rep->bad_until_ms.store(now + quarantine_ms, std::memory_order_relaxed);
+  }
+  return false;
+}
+
+// ---------------- RemoteGraph ----------------
+
+bool RemoteGraph::Init(const std::string& config) {
+  auto cfg = ParseConfig(config);
+  if (cfg.count("retries")) retries_ = std::stoi(cfg["retries"]);
+  if (cfg.count("timeout_ms")) timeout_ms_ = std::stoi(cfg["timeout_ms"]);
+  if (cfg.count("quarantine_ms"))
+    quarantine_ms_ = std::stoi(cfg["quarantine_ms"]);
+
+  // shard -> replica address list
+  std::map<int, std::vector<std::pair<std::string, int>>> shards;
+  if (cfg.count("registry")) {
+    DIR* d = opendir(cfg["registry"].c_str());
+    if (!d) {
+      error_ = "cannot open registry dir " + cfg["registry"];
+      return false;
+    }
+    while (dirent* ent = readdir(d)) {
+      std::string name = ent->d_name;
+      size_t hash = name.find('#');
+      if (hash == std::string::npos || hash == 0) continue;
+      int shard = std::atoi(name.substr(0, hash).c_str());
+      std::ifstream f(cfg["registry"] + "/" + name);
+      std::string line;
+      if (!std::getline(f, line)) continue;
+      std::string host;
+      int port;
+      if (ParseHostPort(line, &host, &port))
+        shards[shard].emplace_back(host, port);
+    }
+    closedir(d);
+  } else if (cfg.count("shards")) {
+    std::stringstream ss(cfg["shards"]);
+    std::string shard_s;
+    int idx = 0;
+    while (std::getline(ss, shard_s, ',')) {
+      std::stringstream rs(shard_s);
+      std::string rep;
+      while (std::getline(rs, rep, '|')) {
+        std::string host;
+        int port;
+        if (ParseHostPort(rep, &host, &port))
+          shards[idx].emplace_back(host, port);
+      }
+      ++idx;
+    }
+  } else {
+    error_ = "remote config needs registry= or shards=";
+    return false;
+  }
+
+  num_shards_ = shards.empty() ? 0 : shards.rbegin()->first + 1;
+  if (num_shards_ <= 0) {
+    error_ = "no shards discovered";
+    return false;
+  }
+  pools_ = std::vector<ConnPool>(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    if (!shards.count(s) || shards[s].empty()) {
+      error_ = "no replicas for shard " + std::to_string(s);
+      return false;
+    }
+    for (auto& [host, port] : shards[s]) pools_[s].AddReplica(host, port);
+  }
+
+  // Per-shard meta: weight sums for cross-shard weighted sampling (the role
+  // of the reference's ZK shard_meta node_sum_weight/edge_sum_weight,
+  // graph_service.cc:141-142 <-> remote_graph.cc:122-155).
+  shard_node_wsum_.resize(num_shards_);
+  shard_edge_wsum_.resize(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    WireWriter req;
+    req.U8(kInfo);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) {
+      error_ = "cannot fetch info from shard " + std::to_string(s);
+      return false;
+    }
+    WireReader r(reply);
+    r.U8();  // status already checked in Call
+    int64_t nn = r.I64(), ne = r.I64();
+    int32_t ntn = r.I32(), etn = r.I32();
+    int32_t f[6];
+    for (int k = 0; k < 6; ++k) f[k] = r.I32();
+    r.I32();  // shard_idx
+    int32_t shard_num = r.I32(), nparts = r.I32();
+    r.Vec(&shard_node_wsum_[s]);
+    r.Vec(&shard_edge_wsum_[s]);
+    if (!r.ok()) {
+      error_ = "malformed info reply from shard " + std::to_string(s);
+      return false;
+    }
+    // Type/slot counts are derived from each shard's local records, so a
+    // shard holding no nodes of the highest types reports fewer types —
+    // the global view is the max (weight vectors are zero-padded below).
+    node_type_num_ = std::max(node_type_num_, ntn);
+    edge_type_num_ = std::max(edge_type_num_, etn);
+    for (int k = 0; k < 6; ++k) fnum_[k] = std::max(fnum_[k], f[k]);
+    if (s == 0) {
+      num_partitions_ = nparts;
+    } else if (nparts != num_partitions_) {
+      error_ = "inconsistent num_partitions across shards";
+      return false;
+    }
+    if (shard_num != num_shards_) {
+      error_ = "shard " + std::to_string(s) + " was started with shard_num " +
+               std::to_string(shard_num) + " but " +
+               std::to_string(num_shards_) + " shards are registered";
+      return false;
+    }
+    num_nodes_ += nn;
+    num_edges_ += ne;
+  }
+  if (cfg.count("num_partitions"))
+    num_partitions_ = std::stoi(cfg["num_partitions"]);
+  if (num_partitions_ <= 0) num_partitions_ = num_shards_;
+
+  // Aggregate weight sums + cross-shard samplers.
+  node_wsum_agg_.assign(node_type_num_, 0.f);
+  edge_wsum_agg_.assign(edge_type_num_, 0.f);
+  std::vector<float> node_tot(num_shards_, 0.f), edge_tot(num_shards_, 0.f);
+  for (int s = 0; s < num_shards_; ++s) {
+    shard_node_wsum_[s].resize(node_type_num_, 0.f);
+    shard_edge_wsum_[s].resize(edge_type_num_, 0.f);
+    for (int t = 0; t < node_type_num_; ++t) {
+      node_wsum_agg_[t] += shard_node_wsum_[s][t];
+      node_tot[s] += shard_node_wsum_[s][t];
+    }
+    for (int t = 0; t < edge_type_num_; ++t) {
+      edge_wsum_agg_[t] += shard_edge_wsum_[s][t];
+      edge_tot[s] += shard_edge_wsum_[s][t];
+    }
+  }
+  node_shard_total_.Build(node_tot);
+  edge_shard_total_.Build(edge_tot);
+  node_shard_by_type_.resize(node_type_num_);
+  edge_shard_by_type_.resize(edge_type_num_);
+  std::vector<float> w(num_shards_);
+  for (int t = 0; t < node_type_num_; ++t) {
+    for (int s = 0; s < num_shards_; ++s) w[s] = shard_node_wsum_[s][t];
+    node_shard_by_type_[t].Build(w);
+  }
+  for (int t = 0; t < edge_type_num_; ++t) {
+    for (int s = 0; s < num_shards_; ++s) w[s] = shard_edge_wsum_[s][t];
+    edge_shard_by_type_[t].Build(w);
+  }
+  return true;
+}
+
+void RemoteGraph::TypeWeightSums(int kind, float* out) const {
+  const auto& v = kind == 0 ? node_wsum_agg_ : edge_wsum_agg_;
+  std::copy(v.begin(), v.end(), out);
+}
+
+bool RemoteGraph::Call(int shard, const std::string& req,
+                       std::string* reply) const {
+  if (!pools_[shard].Call(req, reply, retries_, timeout_ms_, quarantine_ms_))
+    return false;
+  return !reply->empty() && (*reply)[0] == 0;
+}
+
+void RemoteGraph::GroupByShard(const uint64_t* ids, int n,
+                               std::vector<std::vector<int32_t>>* rows) const {
+  rows->assign(num_shards_, {});
+  for (int i = 0; i < n; ++i) (*rows)[ShardOf(ids[i])].push_back(i);
+}
+
+void RemoteGraph::ForShards(const std::vector<std::vector<int32_t>>& rows,
+                            const std::function<bool(int)>& fn) const {
+  std::vector<std::thread> ts;
+  ts.reserve(rows.size());
+  for (int s = 0; s < static_cast<int>(rows.size()); ++s)
+    if (!rows[s].empty()) ts.emplace_back([&fn, s] { fn(s); });
+  for (auto& t : ts) t.join();
+}
+
+void RemoteGraph::DrawShards(bool edges, int32_t type, int count,
+                             int* out) const {
+  Rng& rng = ThreadRng();
+  const PrefixTable* table;
+  if (type < 0)
+    table = edges ? &edge_shard_total_ : &node_shard_total_;
+  else
+    table = edges ? &edge_shard_by_type_[type] : &node_shard_by_type_[type];
+  for (int i = 0; i < count; ++i)
+    out[i] = static_cast<int>(table->Draw(rng));
+}
+
+void RemoteGraph::SampleNode(int count, int32_t type, uint64_t* out) const {
+  if (count <= 0) return;
+  if (type >= node_type_num_) {
+    std::fill(out, out + count, 0);
+    return;
+  }
+  // Per-draw shard assignment proportional to shard weight sums, then one
+  // batched SampleNode per shard, results distributed back to draw slots —
+  // iid-equivalent to the reference's multinomial split + concat
+  // (REMOTE_SAMPLE, remote_graph.cc:195-221).
+  std::vector<int> draw_shard(count);
+  DrawShards(false, type, count, draw_shard.data());
+  std::vector<std::vector<int32_t>> rows(num_shards_);
+  for (int i = 0; i < count; ++i) rows[draw_shard[i]].push_back(i);
+  std::fill(out, out + count, 0);
+  ForShards(rows, [&](int s) {
+    WireWriter req;
+    req.U8(kSampleNode);
+    req.I32(static_cast<int32_t>(rows[s].size()));
+    req.I32(type);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m;
+    const uint64_t* ids = r.Arr<uint64_t>(&m);
+    if (!r.ok() || m != static_cast<int64_t>(rows[s].size())) return false;
+    for (int64_t j = 0; j < m; ++j) out[rows[s][j]] = ids[j];
+    return true;
+  });
+}
+
+void RemoteGraph::SampleEdge(int count, int32_t type, uint64_t* out_src,
+                             uint64_t* out_dst, int32_t* out_type) const {
+  if (count <= 0) return;
+  std::fill(out_src, out_src + count, 0);
+  std::fill(out_dst, out_dst + count, 0);
+  std::fill(out_type, out_type + count, -1);
+  if (type >= edge_type_num_) return;
+  std::vector<int> draw_shard(count);
+  DrawShards(true, type, count, draw_shard.data());
+  std::vector<std::vector<int32_t>> rows(num_shards_);
+  for (int i = 0; i < count; ++i) rows[draw_shard[i]].push_back(i);
+  ForShards(rows, [&](int s) {
+    WireWriter req;
+    req.U8(kSampleEdge);
+    req.I32(static_cast<int32_t>(rows[s].size()));
+    req.I32(type);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m, m2, m3;
+    const uint64_t* src = r.Arr<uint64_t>(&m);
+    const uint64_t* dst = r.Arr<uint64_t>(&m2);
+    const int32_t* t = r.Arr<int32_t>(&m3);
+    if (!r.ok() || m != static_cast<int64_t>(rows[s].size()) || m2 != m ||
+        m3 != m)
+      return false;
+    for (int64_t j = 0; j < m; ++j) {
+      out_src[rows[s][j]] = src[j];
+      out_dst[rows[s][j]] = dst[j];
+      out_type[rows[s][j]] = t[j];
+    }
+    return true;
+  });
+}
+
+void RemoteGraph::GetNodeType(const uint64_t* ids, int n,
+                              int32_t* out) const {
+  std::fill(out, out + n, -1);
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> sub(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kNodeType);
+    req.Arr(sub);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m;
+    const int32_t* t = r.Arr<int32_t>(&m);
+    if (!r.ok() || m != static_cast<int64_t>(sub.size())) return false;
+    for (int64_t j = 0; j < m; ++j) out[rows[s][j]] = t[j];
+    return true;
+  });
+}
+
+void RemoteGraph::SampleNodeWithSrc(const uint64_t* src, int n, int count,
+                                    uint64_t* out) const {
+  // Engine semantics (eg_engine.cc SampleNodeWithSrc): each row samples
+  // `count` nodes from the global sampler of the src node's type (type -1 —
+  // missing src — falls back to the all-types sampler). Remotely: resolve
+  // src types, draw a shard per (row, draw) from that type's cross-shard
+  // table, batch one SampleNode per (shard, type).
+  std::vector<int32_t> types(n);
+  GetNodeType(src, n, types.data());
+  Rng& rng = ThreadRng();
+  int64_t total = static_cast<int64_t>(n) * count;
+  std::fill(out, out + total, 0);
+  // (shard, type) -> slot list into out
+  std::map<std::pair<int, int32_t>, std::vector<int64_t>> groups;
+  for (int i = 0; i < n; ++i) {
+    int32_t t = types[i] >= 0 && types[i] < node_type_num_ ? types[i] : -1;
+    const PrefixTable& table =
+        t < 0 ? node_shard_total_ : node_shard_by_type_[t];
+    for (int j = 0; j < count; ++j) {
+      int s = static_cast<int>(table.Draw(rng));
+      groups[{s, t}].push_back(static_cast<int64_t>(i) * count + j);
+    }
+  }
+  std::vector<std::thread> ts;
+  for (auto& [key, slots] : groups) {
+    ts.emplace_back([this, &key = key, &slots = slots, out] {
+      WireWriter req;
+      req.U8(kSampleNode);
+      req.I32(static_cast<int32_t>(slots.size()));
+      req.I32(key.second);
+      std::string reply;
+      if (!Call(key.first, req.buf(), &reply)) return;
+      WireReader r(reply);
+      r.U8();
+      int64_t m;
+      const uint64_t* ids = r.Arr<uint64_t>(&m);
+      if (!r.ok() || m != static_cast<int64_t>(slots.size())) return;
+      for (int64_t j = 0; j < m; ++j) out[slots[j]] = ids[j];
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
+                                 const int32_t* etypes, int net, int count,
+                                 uint64_t default_id, uint64_t* out_ids,
+                                 float* out_w, int32_t* out_t) const {
+  int64_t total = static_cast<int64_t>(n) * count;
+  std::fill(out_ids, out_ids + total, default_id);
+  std::fill(out_w, out_w + total, 0.f);
+  std::fill(out_t, out_t + total, -1);
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> sub(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kSampleNeighbor);
+    req.Arr(sub);
+    req.Arr(etypes, net);
+    req.I32(count);
+    req.U64(default_id);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m, mw, mt;
+    const uint64_t* rid = r.Arr<uint64_t>(&m);
+    const float* rw = r.Arr<float>(&mw);
+    const int32_t* rt = r.Arr<int32_t>(&mt);
+    int64_t want = static_cast<int64_t>(sub.size()) * count;
+    if (!r.ok() || m != want || mw != want || mt != want) return false;
+    for (size_t j = 0; j < rows[s].size(); ++j) {
+      int64_t dst_off = static_cast<int64_t>(rows[s][j]) * count;
+      int64_t src_off = static_cast<int64_t>(j) * count;
+      std::copy(rid + src_off, rid + src_off + count, out_ids + dst_off);
+      std::copy(rw + src_off, rw + src_off + count, out_w + dst_off);
+      std::copy(rt + src_off, rt + src_off + count, out_t + dst_off);
+    }
+    return true;
+  });
+}
+
+void RemoteGraph::SampleFanout(const uint64_t* ids, int n,
+                               const int32_t* etypes_flat,
+                               const int32_t* etype_counts,
+                               const int32_t* counts, int nhops,
+                               uint64_t default_id, uint64_t** out_ids,
+                               float** out_w, int32_t** out_t) const {
+  const uint64_t* cur = ids;
+  int64_t cur_n = n;
+  const int32_t* et = etypes_flat;
+  for (int h = 0; h < nhops; ++h) {
+    SampleNeighbor(cur, static_cast<int>(cur_n), et, etype_counts[h],
+                   counts[h], default_id, out_ids[h], out_w[h], out_t[h]);
+    cur = out_ids[h];
+    cur_n *= counts[h];
+    et += etype_counts[h];
+  }
+}
+
+namespace {
+
+// Invert rows[s] lists into per-row (shard, position-within-shard) maps.
+void RowOwners(const std::vector<std::vector<int32_t>>& rows, int n,
+               std::vector<int32_t>* shard_of, std::vector<int32_t>* pos_of) {
+  shard_of->assign(n, -1);
+  pos_of->assign(n, 0);
+  for (size_t s = 0; s < rows.size(); ++s)
+    for (size_t j = 0; j < rows[s].size(); ++j) {
+      (*shard_of)[rows[s][j]] = static_cast<int32_t>(s);
+      (*pos_of)[rows[s][j]] = static_cast<int32_t>(j);
+    }
+}
+
+// Prefix offsets of a counts array.
+std::vector<int64_t> Offsets(const std::vector<int32_t>& counts) {
+  std::vector<int64_t> off(counts.size() + 1, 0);
+  for (size_t j = 0; j < counts.size(); ++j) off[j + 1] = off[j] + counts[j];
+  return off;
+}
+
+}  // namespace
+
+EGResult* RemoteGraph::MergeFullNeighbor(
+    const std::vector<std::vector<int32_t>>& rows, std::vector<EGResult>& sub,
+    const std::vector<char>& ok, int n) const {
+  auto* res = new EGResult();
+  res->u64.resize(1);
+  res->f32.resize(1);
+  res->i32.resize(2);
+  res->i32[1].assign(n, 0);
+  std::vector<int32_t> shard_of, pos_of;
+  RowOwners(rows, n, &shard_of, &pos_of);
+  std::vector<std::vector<int64_t>> off(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    // Validate reply shape before trusting its counts — a malformed shard
+    // reply degrades to defaults, like the fixed-size paths' m != want
+    // checks.
+    if (!ok[s] || sub[s].i32.size() != 2 || sub[s].u64.size() != 1 ||
+        sub[s].f32.size() != 1 ||
+        sub[s].i32[1].size() != rows[s].size())
+      continue;
+    auto o = Offsets(sub[s].i32[1]);
+    size_t total = static_cast<size_t>(o.back());
+    if (sub[s].u64[0].size() != total || sub[s].f32[0].size() != total ||
+        sub[s].i32[0].size() != total)
+      continue;
+    off[s] = std::move(o);
+  }
+  for (int i = 0; i < n; ++i) {
+    int s = shard_of[i];
+    if (s < 0 || !ok[s] || off[s].empty()) continue;  // defaults: count 0
+    int32_t j = pos_of[i];
+    int64_t b = off[s][j], e = off[s][j + 1];
+    res->i32[1][i] = static_cast<int32_t>(e - b);
+    res->u64[0].insert(res->u64[0].end(), sub[s].u64[0].begin() + b,
+                       sub[s].u64[0].begin() + e);
+    res->f32[0].insert(res->f32[0].end(), sub[s].f32[0].begin() + b,
+                       sub[s].f32[0].begin() + e);
+    res->i32[0].insert(res->i32[0].end(), sub[s].i32[0].begin() + b,
+                       sub[s].i32[0].begin() + e);
+  }
+  return res;
+}
+
+EGResult* RemoteGraph::MergeSlotted(
+    const std::vector<std::vector<int32_t>>& rows, std::vector<EGResult>& sub,
+    const std::vector<char>& ok, int n, int nf, bool u64_vals,
+    bool byte_vals) const {
+  auto* res = new EGResult();
+  res->i32.resize(nf);
+  if (u64_vals) res->u64.resize(nf);
+  if (byte_vals) res->bytes.resize(nf);
+  std::vector<int32_t> shard_of, pos_of;
+  RowOwners(rows, n, &shard_of, &pos_of);
+  for (int k = 0; k < nf; ++k) {
+    res->i32[k].assign(n, 0);
+    std::vector<std::vector<int64_t>> off(num_shards_);
+    for (int s = 0; s < num_shards_; ++s) {
+      if (!ok[s] || static_cast<int>(sub[s].i32.size()) != nf ||
+          sub[s].i32[k].size() != rows[s].size())
+        continue;
+      if (u64_vals && static_cast<int>(sub[s].u64.size()) != nf) continue;
+      if (byte_vals && static_cast<int>(sub[s].bytes.size()) != nf) continue;
+      auto o = Offsets(sub[s].i32[k]);
+      size_t total = static_cast<size_t>(o.back());
+      if (u64_vals && sub[s].u64[k].size() != total) continue;
+      if (byte_vals && sub[s].bytes[k].size() != total) continue;
+      off[s] = std::move(o);
+    }
+    for (int i = 0; i < n; ++i) {
+      int s = shard_of[i];
+      if (s < 0 || !ok[s] || off[s].empty()) continue;
+      int32_t j = pos_of[i];
+      int64_t b = off[s][j], e = off[s][j + 1];
+      res->i32[k][i] = static_cast<int32_t>(e - b);
+      if (u64_vals)
+        res->u64[k].insert(res->u64[k].end(), sub[s].u64[k].begin() + b,
+                           sub[s].u64[k].begin() + e);
+      if (byte_vals)
+        res->bytes[k].append(sub[s].bytes[k], static_cast<size_t>(b),
+                             static_cast<size_t>(e - b));
+    }
+  }
+  return res;
+}
+
+EGResult* RemoteGraph::GetFullNeighbor(const uint64_t* ids, int n,
+                                       const int32_t* etypes, int net,
+                                       bool sorted) const {
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  std::vector<EGResult> sub(num_shards_);
+  std::vector<char> ok(num_shards_, 0);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> subids(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) subids[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kFullNeighbor);
+    req.Arr(subids);
+    req.Arr(etypes, net);
+    req.U8(sorted ? 1 : 0);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    if (!ReadResult(&r, &sub[s])) return false;
+    ok[s] = 1;
+    return true;
+  });
+  // Engine layout: u64[0]=ids, f32[0]=weights, i32[0]=types, i32[1]=counts.
+  return MergeFullNeighbor(rows, sub, ok, n);
+}
+
+void RemoteGraph::GetTopKNeighbor(const uint64_t* ids, int n,
+                                  const int32_t* etypes, int net, int k,
+                                  uint64_t default_id, uint64_t* out_ids,
+                                  float* out_w, int32_t* out_t) const {
+  int64_t total = static_cast<int64_t>(n) * k;
+  std::fill(out_ids, out_ids + total, default_id);
+  std::fill(out_w, out_w + total, 0.f);
+  std::fill(out_t, out_t + total, -1);
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> sub(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kTopKNeighbor);
+    req.Arr(sub);
+    req.Arr(etypes, net);
+    req.I32(k);
+    req.U64(default_id);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m, mw, mt;
+    const uint64_t* rid = r.Arr<uint64_t>(&m);
+    const float* rw = r.Arr<float>(&mw);
+    const int32_t* rt = r.Arr<int32_t>(&mt);
+    int64_t want = static_cast<int64_t>(sub.size()) * k;
+    if (!r.ok() || m != want || mw != want || mt != want) return false;
+    for (size_t j = 0; j < rows[s].size(); ++j) {
+      int64_t dst_off = static_cast<int64_t>(rows[s][j]) * k;
+      int64_t src_off = static_cast<int64_t>(j) * k;
+      std::copy(rid + src_off, rid + src_off + k, out_ids + dst_off);
+      std::copy(rw + src_off, rw + src_off + k, out_w + dst_off);
+      std::copy(rt + src_off, rt + src_off + k, out_t + dst_off);
+    }
+    return true;
+  });
+}
+
+void RemoteGraph::RandomWalk(const uint64_t* ids, int n,
+                             const int32_t* etypes_flat,
+                             const int32_t* etype_counts, int walk_len,
+                             float p, float q, uint64_t default_id,
+                             uint64_t* out) const {
+  int64_t stride = walk_len + 1;
+  std::vector<uint64_t> cur(ids, ids + n), parent(n, 0);
+  for (int i = 0; i < n; ++i) out[static_cast<int64_t>(i) * stride] = ids[i];
+  bool plain = p == 1.f && q == 1.f;
+  std::vector<uint64_t> next(n);
+  std::vector<float> w1(n);
+  std::vector<int32_t> t1(n);
+  Rng& rng = ThreadRng();
+  const int32_t* et = etypes_flat;
+  for (int s = 1; s <= walk_len; ++s) {
+    int net = etype_counts[s - 1];
+    if (plain || s == 1) {
+      SampleNeighbor(cur.data(), n, et, net, 1, default_id, next.data(),
+                     w1.data(), t1.data());
+    } else {
+      // node2vec-biased step: client-side sorted-merge of current and parent
+      // neighbor lists, d_tx weights w/p (return), w (distance 1), w/q
+      // (distance 2) — semantics of reference euler/client/graph.cc:120-151,
+      // which likewise issues two GetSortedFullNeighbor scatters per hop.
+      EGResult* cn = GetFullNeighbor(cur.data(), n, et, net, true);
+      EGResult* pn = GetFullNeighbor(parent.data(), n, et, net, true);
+      const auto& c_ids = cn->u64[0];
+      const auto& c_w = cn->f32[0];
+      const auto& c_cnt = cn->i32[1];
+      const auto& p_ids = pn->u64[0];
+      const auto& p_cnt = pn->i32[1];
+      size_t c_off = 0, p_off = 0;
+      std::vector<double> cum;
+      for (int i = 0; i < n; ++i) {
+        size_t cc = static_cast<size_t>(c_cnt[i]);
+        size_t pc = static_cast<size_t>(p_cnt[i]);
+        if (cc == 0) {
+          next[i] = default_id;
+        } else {
+          cum.resize(cc);
+          double total = 0.0;
+          const uint64_t* pb = p_ids.data() + p_off;
+          for (size_t j = 0; j < cc; ++j) {
+            uint64_t x = c_ids[c_off + j];
+            float wx = c_w[c_off + j];
+            double scale;
+            if (x == parent[i])
+              scale = 1.0 / p;
+            else if (std::binary_search(pb, pb + pc, x))
+              scale = 1.0;
+            else
+              scale = 1.0 / q;
+            total += wx * scale;
+            cum[j] = total;
+          }
+          double r = rng.NextDouble() * total;
+          size_t j = std::lower_bound(cum.begin(), cum.end(), r) - cum.begin();
+          next[i] = c_ids[c_off + std::min(j, cc - 1)];
+        }
+        c_off += cc;
+        p_off += pc;
+      }
+      delete cn;
+      delete pn;
+    }
+    for (int i = 0; i < n; ++i) {
+      out[static_cast<int64_t>(i) * stride + s] = next[i];
+      parent[i] = cur[i];
+      cur[i] = next[i];
+    }
+    et += net;
+  }
+}
+
+void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
+                                  const int32_t* fids, const int32_t* dims,
+                                  int nf, float* out) const {
+  int64_t row_dim = 0;
+  for (int k = 0; k < nf; ++k) row_dim += dims[k];
+  std::fill(out, out + static_cast<int64_t>(n) * row_dim, 0.f);
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> sub(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kDenseFeature);
+    req.Arr(sub);
+    req.Arr(fids, nf);
+    req.Arr(dims, nf);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m;
+    const float* vals = r.Arr<float>(&m);
+    if (!r.ok() || m != static_cast<int64_t>(sub.size()) * row_dim)
+      return false;
+    for (size_t j = 0; j < rows[s].size(); ++j)
+      std::copy(vals + j * row_dim, vals + (j + 1) * row_dim,
+                out + static_cast<int64_t>(rows[s][j]) * row_dim);
+    return true;
+  });
+}
+
+void RemoteGraph::GetEdgeDenseFeature(const uint64_t* src,
+                                      const uint64_t* dst,
+                                      const int32_t* types, int n,
+                                      const int32_t* fids,
+                                      const int32_t* dims, int nf,
+                                      float* out) const {
+  int64_t row_dim = 0;
+  for (int k = 0; k < nf; ++k) row_dim += dims[k];
+  std::fill(out, out + static_cast<int64_t>(n) * row_dim, 0.f);
+  // Edges live on the shard of their src node (the converter emits edge
+  // records inside the src node's block — see convert.py / reference
+  // euler/tools/json2dat.py:139).
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(src, n, &rows);
+  ForShards(rows, [&](int s) {
+    size_t m = rows[s].size();
+    std::vector<uint64_t> ssrc(m), sdst(m);
+    std::vector<int32_t> st(m);
+    for (size_t j = 0; j < m; ++j) {
+      ssrc[j] = src[rows[s][j]];
+      sdst[j] = dst[rows[s][j]];
+      st[j] = types[rows[s][j]];
+    }
+    WireWriter req;
+    req.U8(kEdgeDenseFeature);
+    req.Arr(ssrc);
+    req.Arr(sdst);
+    req.Arr(st);
+    req.Arr(fids, nf);
+    req.Arr(dims, nf);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t mm;
+    const float* vals = r.Arr<float>(&mm);
+    if (!r.ok() || mm != static_cast<int64_t>(m) * row_dim) return false;
+    for (size_t j = 0; j < m; ++j)
+      std::copy(vals + j * row_dim, vals + (j + 1) * row_dim,
+                out + static_cast<int64_t>(rows[s][j]) * row_dim);
+    return true;
+  });
+}
+
+EGResult* RemoteGraph::GetSparseFeature(const uint64_t* ids, int n,
+                                        const int32_t* fids, int nf) const {
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  std::vector<EGResult> sub(num_shards_);
+  std::vector<char> ok(num_shards_, 0);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> subids(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) subids[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kSparseFeature);
+    req.Arr(subids);
+    req.Arr(fids, nf);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    if (!ReadResult(&r, &sub[s])) return false;
+    ok[s] = 1;
+    return true;
+  });
+  // Layout: u64[k]=values of slot k, i32[k]=per-row counts (nf slots each).
+  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/true, /*bytes=*/false);
+}
+
+EGResult* RemoteGraph::GetEdgeSparseFeature(const uint64_t* src,
+                                            const uint64_t* dst,
+                                            const int32_t* types, int n,
+                                            const int32_t* fids,
+                                            int nf) const {
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(src, n, &rows);
+  std::vector<EGResult> sub(num_shards_);
+  std::vector<char> ok(num_shards_, 0);
+  ForShards(rows, [&](int s) {
+    size_t m = rows[s].size();
+    std::vector<uint64_t> ssrc(m), sdst(m);
+    std::vector<int32_t> st(m);
+    for (size_t j = 0; j < m; ++j) {
+      ssrc[j] = src[rows[s][j]];
+      sdst[j] = dst[rows[s][j]];
+      st[j] = types[rows[s][j]];
+    }
+    WireWriter req;
+    req.U8(kEdgeSparseFeature);
+    req.Arr(ssrc);
+    req.Arr(sdst);
+    req.Arr(st);
+    req.Arr(fids, nf);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    if (!ReadResult(&r, &sub[s])) return false;
+    ok[s] = 1;
+    return true;
+  });
+  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/true, /*bytes=*/false);
+}
+
+EGResult* RemoteGraph::GetBinaryFeature(const uint64_t* ids, int n,
+                                        const int32_t* fids, int nf) const {
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  std::vector<EGResult> sub(num_shards_);
+  std::vector<char> ok(num_shards_, 0);
+  ForShards(rows, [&](int s) {
+    std::vector<uint64_t> subids(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) subids[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kBinaryFeature);
+    req.Arr(subids);
+    req.Arr(fids, nf);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    if (!ReadResult(&r, &sub[s])) return false;
+    ok[s] = 1;
+    return true;
+  });
+  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/false, /*bytes=*/true);
+}
+
+EGResult* RemoteGraph::GetEdgeBinaryFeature(const uint64_t* src,
+                                            const uint64_t* dst,
+                                            const int32_t* types, int n,
+                                            const int32_t* fids,
+                                            int nf) const {
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(src, n, &rows);
+  std::vector<EGResult> sub(num_shards_);
+  std::vector<char> ok(num_shards_, 0);
+  ForShards(rows, [&](int s) {
+    size_t m = rows[s].size();
+    std::vector<uint64_t> ssrc(m), sdst(m);
+    std::vector<int32_t> st(m);
+    for (size_t j = 0; j < m; ++j) {
+      ssrc[j] = src[rows[s][j]];
+      sdst[j] = dst[rows[s][j]];
+      st[j] = types[rows[s][j]];
+    }
+    WireWriter req;
+    req.U8(kEdgeBinaryFeature);
+    req.Arr(ssrc);
+    req.Arr(sdst);
+    req.Arr(st);
+    req.Arr(fids, nf);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    if (!ReadResult(&r, &sub[s])) return false;
+    ok[s] = 1;
+    return true;
+  });
+  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/false, /*bytes=*/true);
+}
+
+}  // namespace eg

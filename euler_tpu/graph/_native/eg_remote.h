@@ -1,0 +1,177 @@
+// Remote sharded graph client.
+//
+// Role equivalent of the reference RemoteGraph + RpcManager stack
+// (reference euler/client/remote_graph.{h,cc}, remote_graph_shard.cc,
+// rpc_manager.{h,cc}, rpc_client.cc): partition routing
+// shard(id) = (id % num_partitions) % num_shards (remote_graph.h:118-129),
+// per-request scatter by shard + ordered gather merge
+// (remote_graph.cc:33-66,241-261), weighted cross-shard global sampling
+// proportional to per-shard weight sums (REMOTE_SAMPLE,
+// remote_graph.cc:195-221), node2vec-biased walking via client-side
+// sorted-neighbor merge (graph.cc:120-151), and per-shard replica pools with
+// retry + timed bad-host quarantine (rpc_manager.h:68-122,
+// rpc_client.cc:29-49). Differences: the transport is the zero-dependency
+// wire protocol of eg_wire.h instead of gRPC, calls are batch-synchronous
+// (per-shard fan-out runs on ephemeral threads joined before return), and
+// discovery is the flat-file registry of eg_service.h instead of ZooKeeper.
+#ifndef EG_REMOTE_H_
+#define EG_REMOTE_H_
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "eg_api.h"
+#include "eg_engine.h"
+#include "eg_sampling.h"
+#include "eg_wire.h"
+
+namespace eg {
+
+// Connection pool over the replicas of one shard: round-robin with
+// quarantine of failing hosts, idle-socket reuse, retry across replicas.
+class ConnPool {
+ public:
+  struct Replica {
+    std::string host;
+    int port = 0;
+    std::atomic<int64_t> bad_until_ms{0};
+    std::mutex mu;
+    std::vector<int> idle;  // pooled connected sockets
+  };
+
+  void AddReplica(const std::string& host, int port);
+  ~ConnPool();
+
+  size_t num_replicas() const { return replicas_.size(); }
+
+  // One request/reply exchange; retries across replicas. Returns false when
+  // every attempt failed (reply undefined).
+  bool Call(const std::string& req, std::string* reply, int retries,
+            int timeout_ms, int quarantine_ms) const;
+
+ private:
+  std::vector<std::unique_ptr<Replica>> replicas_;
+  mutable std::atomic<size_t> rr_{0};
+};
+
+class RemoteGraph : public GraphAPI {
+ public:
+  // Config: semicolon-separated k=v (string form shared with the reference's
+  // GraphConfig, graph_config.cc:33-56). Keys:
+  //   registry=<dir>        flat-file registry written by Service::Start, OR
+  //   shards=<h:p|h:p,...>  explicit per-shard replica lists
+  //                         (',' separates shards, '|' separates replicas)
+  //   retries (default 3), timeout_ms (5000), quarantine_ms (3000)
+  bool Init(const std::string& config);
+  const std::string& error() const { return error_; }
+
+  int num_shards() const { return num_shards_; }
+  int num_partitions() const { return num_partitions_; }
+
+  // ---- GraphAPI ----
+  int64_t NumNodes() const override { return num_nodes_; }
+  int64_t NumEdges() const override { return num_edges_; }
+  int32_t NodeTypeNum() const override { return node_type_num_; }
+  int32_t EdgeTypeNum() const override { return edge_type_num_; }
+  int32_t FeatureNum(int kind) const override {
+    return kind >= 0 && kind < 6 ? fnum_[kind] : -1;
+  }
+  void TypeWeightSums(int kind, float* out) const override;
+
+  void SampleNode(int count, int32_t type, uint64_t* out) const override;
+  void SampleEdge(int count, int32_t type, uint64_t* out_src,
+                  uint64_t* out_dst, int32_t* out_type) const override;
+  void SampleNodeWithSrc(const uint64_t* src, int n, int count,
+                         uint64_t* out) const override;
+  void GetNodeType(const uint64_t* ids, int n, int32_t* out) const override;
+
+  void SampleNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
+                      int net, int count, uint64_t default_id,
+                      uint64_t* out_ids, float* out_w,
+                      int32_t* out_t) const override;
+  void SampleFanout(const uint64_t* ids, int n, const int32_t* etypes_flat,
+                    const int32_t* etype_counts, const int32_t* counts,
+                    int nhops, uint64_t default_id, uint64_t** out_ids,
+                    float** out_w, int32_t** out_t) const override;
+  EGResult* GetFullNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
+                            int net, bool sorted) const override;
+  void GetTopKNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
+                       int net, int k, uint64_t default_id, uint64_t* out_ids,
+                       float* out_w, int32_t* out_t) const override;
+
+  void RandomWalk(const uint64_t* ids, int n, const int32_t* etypes_flat,
+                  const int32_t* etype_counts, int walk_len, float p, float q,
+                  uint64_t default_id, uint64_t* out) const override;
+
+  void GetDenseFeature(const uint64_t* ids, int n, const int32_t* fids,
+                       const int32_t* dims, int nf,
+                       float* out) const override;
+  void GetEdgeDenseFeature(const uint64_t* src, const uint64_t* dst,
+                           const int32_t* types, int n, const int32_t* fids,
+                           const int32_t* dims, int nf,
+                           float* out) const override;
+  EGResult* GetSparseFeature(const uint64_t* ids, int n, const int32_t* fids,
+                             int nf) const override;
+  EGResult* GetEdgeSparseFeature(const uint64_t* src, const uint64_t* dst,
+                                 const int32_t* types, int n,
+                                 const int32_t* fids, int nf) const override;
+  EGResult* GetBinaryFeature(const uint64_t* ids, int n, const int32_t* fids,
+                             int nf) const override;
+  EGResult* GetEdgeBinaryFeature(const uint64_t* src, const uint64_t* dst,
+                                 const int32_t* types, int n,
+                                 const int32_t* fids, int nf) const override;
+
+ private:
+  inline int ShardOf(uint64_t id) const {
+    return static_cast<int>((id % static_cast<uint64_t>(num_partitions_)) %
+                            static_cast<uint64_t>(num_shards_));
+  }
+  // rows[s] = ascending list of row indices owned by shard s.
+  void GroupByShard(const uint64_t* ids, int n,
+                    std::vector<std::vector<int32_t>>* rows) const;
+  // Issue req to shard; decode reply past the status byte into *r.
+  // False on transport failure or error status.
+  bool Call(int shard, const std::string& req, std::string* reply) const;
+  // Run fn(s) concurrently for every shard with rows; fn returns false on
+  // failure (affected rows keep their prefilled defaults).
+  void ForShards(const std::vector<std::vector<int32_t>>& rows,
+                 const std::function<bool(int)>& fn) const;
+  // Weighted multinomial draw of a shard per sample; type==-1 uses totals.
+  void DrawShards(bool edges, int32_t type, int count, int* out) const;
+  // Gather merges for variable-length sub-results (ordered re-assembly, the
+  // role of the reference's MergeCallback, remote_graph.cc:241-261).
+  // FullNeighbor layout: u64[0]/f32[0]/i32[0] values + i32[1] row counts.
+  EGResult* MergeFullNeighbor(const std::vector<std::vector<int32_t>>& rows,
+                              std::vector<EGResult>& sub,
+                              const std::vector<char>& ok, int n) const;
+  // Sparse/binary features: nf slots, values in u64[k] or bytes[k], row
+  // counts in i32[k].
+  EGResult* MergeSlotted(const std::vector<std::vector<int32_t>>& rows,
+                         std::vector<EGResult>& sub,
+                         const std::vector<char>& ok, int n, int nf,
+                         bool u64_vals, bool byte_vals) const;
+
+  std::string error_;
+  int num_shards_ = 0, num_partitions_ = 1;
+  int retries_ = 3, timeout_ms_ = 5000, quarantine_ms_ = 3000;
+
+  int64_t num_nodes_ = 0, num_edges_ = 0;
+  int32_t node_type_num_ = 0, edge_type_num_ = 0;
+  int32_t fnum_[6] = {0, 0, 0, 0, 0, 0};
+  std::vector<float> node_wsum_agg_, edge_wsum_agg_;
+  // Per-shard per-type weight sums [shard][type] and totals.
+  std::vector<std::vector<float>> shard_node_wsum_, shard_edge_wsum_;
+
+  std::vector<ConnPool> pools_;
+  // Cross-shard samplers: per type a table over shards, plus totals tables.
+  std::vector<PrefixTable> node_shard_by_type_, edge_shard_by_type_;
+  PrefixTable node_shard_total_, edge_shard_total_;
+};
+
+}  // namespace eg
+
+#endif  // EG_REMOTE_H_

@@ -1,0 +1,349 @@
+#include "eg_service.h"
+
+#include <dirent.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <fstream>
+
+#include "eg_wire.h"
+
+namespace eg {
+
+namespace {
+
+// Encode an EGResult (all slots of every kind) and free it.
+void WriteResult(WireWriter* w, EGResult* res) {
+  w->I32(static_cast<int32_t>(res->u64.size()));
+  for (auto& v : res->u64) w->Arr(v);
+  w->I32(static_cast<int32_t>(res->f32.size()));
+  for (auto& v : res->f32) w->Arr(v);
+  w->I32(static_cast<int32_t>(res->i32.size()));
+  for (auto& v : res->i32) w->Arr(v);
+  w->I32(static_cast<int32_t>(res->bytes.size()));
+  for (auto& s : res->bytes) w->Str(s);
+  delete res;
+}
+
+}  // namespace
+
+int CountPartitions(const std::string& dir) {
+  DIR* d = opendir(dir.c_str());
+  if (!d) return -1;
+  int max_p = -1;
+  while (dirent* ent = readdir(d)) {
+    std::string name = ent->d_name;
+    if (name.size() < 4 || name.compare(name.size() - 4, 4, ".dat") != 0)
+      continue;
+    int p = 0;
+    size_t us = name.rfind('_');
+    if (us != std::string::npos) {
+      size_t start = us + 1, end = name.size() - 4;
+      bool digits = start < end;
+      for (size_t i = start; i < end && digits; ++i)
+        digits = name[i] >= '0' && name[i] <= '9';
+      if (digits) p = std::stoi(name.substr(start, end - start));
+    }
+    max_p = std::max(max_p, p);
+  }
+  closedir(d);
+  return max_p + 1;
+}
+
+bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
+                    const std::string& host, int port,
+                    const std::string& registry_dir) {
+  shard_idx_ = shard_idx;
+  shard_num_ = shard_num;
+  num_partitions_ = CountPartitions(data_dir);
+  if (num_partitions_ <= 0) {
+    error_ = "no .dat partitions in " + data_dir;
+    return false;
+  }
+  if (!engine_.Load(data_dir, shard_idx, shard_num)) {
+    error_ = engine_.error();
+    return false;
+  }
+  host_ = host.empty() ? "127.0.0.1" : host;
+  listen_fd_ = ListenTcp(host_, port, &port_);
+  if (listen_fd_ < 0) {
+    error_ = "cannot bind port " + std::to_string(port);
+    return false;
+  }
+  stopping_ = false;
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+
+  if (!registry_dir.empty()) {
+    // "<shard>#<host>_<port>" file, written via rename for atomicity — the
+    // flat-file stand-in for the reference's ephemeral znode
+    // (zk_server_register.cc:32-48).
+    registry_file_ = registry_dir + "/" + std::to_string(shard_idx) + "#" +
+                     host_ + "_" + std::to_string(port_);
+    std::string tmp = registry_file_ + ".tmp";
+    std::ofstream f(tmp);
+    f << host_ << ":" << port_ << "\n";
+    f.close();
+    if (!f || std::rename(tmp.c_str(), registry_file_.c_str()) != 0) {
+      error_ = "cannot write registry file " + registry_file_;
+      Stop();
+      return false;
+    }
+  }
+  return true;
+}
+
+void Service::Stop() {
+  if (listen_fd_ < 0) return;
+  stopping_ = true;
+  ::shutdown(listen_fd_, SHUT_RDWR);
+  ::close(listen_fd_);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  listen_fd_ = -1;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  // Handlers are detached; wait for them to drain before we destruct.
+  while (active_conns_.load(std::memory_order_acquire) > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  if (!registry_file_.empty()) {
+    ::unlink(registry_file_.c_str());
+    registry_file_.clear();
+  }
+}
+
+void Service::AcceptLoop() {
+  while (!stopping_) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (stopping_) break;
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      conn_fds_.insert(fd);
+    }
+    active_conns_.fetch_add(1, std::memory_order_acq_rel);
+    std::thread([this, fd] { HandleConn(fd); }).detach();
+  }
+}
+
+void Service::HandleConn(int fd) {
+  std::string req, reply;
+  while (!stopping_) {
+    if (!RecvFrame(fd, &req)) break;
+    reply.clear();
+    Dispatch(req, &reply);
+    if (!SendFrame(fd, reply)) break;
+  }
+  // Deregister before close: Stop() only shuts down fds still in the set,
+  // so it can never touch a closed (possibly recycled) descriptor.
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    conn_fds_.erase(fd);
+  }
+  ::close(fd);
+  active_conns_.fetch_sub(1, std::memory_order_acq_rel);
+}
+
+void Service::Dispatch(const std::string& req, std::string* reply) const {
+  WireReader r(req);
+  uint8_t op = r.U8();
+  WireWriter w;
+  w.U8(0);  // ok status; overwritten on decode error below
+
+  switch (op) {
+    case kPing:
+      break;
+    case kInfo: {
+      const GraphStore& s = engine_.store();
+      w.I64(static_cast<int64_t>(s.num_nodes()));
+      w.I64(static_cast<int64_t>(s.num_edges()));
+      w.I32(s.node_type_num());
+      w.I32(s.edge_type_num());
+      w.I32(s.nf_u64_num());
+      w.I32(s.nf_f32_num());
+      w.I32(s.nf_bin_num());
+      w.I32(s.ef_u64_num());
+      w.I32(s.ef_f32_num());
+      w.I32(s.ef_bin_num());
+      w.I32(shard_idx_);
+      w.I32(shard_num_);
+      w.I32(num_partitions_);
+      w.Arr(s.node_type_weight_sums());
+      w.Arr(s.edge_type_weight_sums());
+      break;
+    }
+    case kSampleNode: {
+      int32_t count = r.I32(), type = r.I32();
+      std::vector<uint64_t> out(std::max<int32_t>(count, 0));
+      if (r.ok() && count >= 0) engine_.SampleNode(count, type, out.data());
+      w.Arr(out);
+      break;
+    }
+    case kSampleEdge: {
+      int32_t count = r.I32(), type = r.I32();
+      size_t n = static_cast<size_t>(std::max<int32_t>(count, 0));
+      std::vector<uint64_t> src(n), dst(n);
+      std::vector<int32_t> t(n);
+      if (r.ok() && count >= 0)
+        engine_.SampleEdge(count, type, src.data(), dst.data(), t.data());
+      w.Arr(src);
+      w.Arr(dst);
+      w.Arr(t);
+      break;
+    }
+    case kNodeType: {
+      int64_t n;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      std::vector<int32_t> out(static_cast<size_t>(n));
+      if (r.ok()) engine_.GetNodeType(ids, static_cast<int>(n), out.data());
+      w.Arr(out);
+      break;
+    }
+    case kSampleNeighbor: {
+      int64_t n, net;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* etypes = r.Arr<int32_t>(&net);
+      int32_t count = r.I32();
+      uint64_t def = r.U64();
+      size_t total = static_cast<size_t>(n) * std::max<int32_t>(count, 0);
+      std::vector<uint64_t> oid(total);
+      std::vector<float> ow(total);
+      std::vector<int32_t> ot(total);
+      if (r.ok() && count >= 0)
+        engine_.SampleNeighbor(ids, static_cast<int>(n), etypes,
+                               static_cast<int>(net), count, def, oid.data(),
+                               ow.data(), ot.data());
+      w.Arr(oid);
+      w.Arr(ow);
+      w.Arr(ot);
+      break;
+    }
+    case kFullNeighbor: {
+      int64_t n, net;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* etypes = r.Arr<int32_t>(&net);
+      uint8_t sorted = r.U8();
+      if (r.ok()) {
+        WriteResult(&w, engine_.GetFullNeighbor(ids, static_cast<int>(n),
+                                                etypes, static_cast<int>(net),
+                                                sorted != 0));
+      }
+      break;
+    }
+    case kTopKNeighbor: {
+      int64_t n, net;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* etypes = r.Arr<int32_t>(&net);
+      int32_t k = r.I32();
+      uint64_t def = r.U64();
+      size_t total = static_cast<size_t>(n) * std::max<int32_t>(k, 0);
+      std::vector<uint64_t> oid(total);
+      std::vector<float> ow(total);
+      std::vector<int32_t> ot(total);
+      if (r.ok() && k >= 0)
+        engine_.GetTopKNeighbor(ids, static_cast<int>(n), etypes,
+                                static_cast<int>(net), k, def, oid.data(),
+                                ow.data(), ot.data());
+      w.Arr(oid);
+      w.Arr(ow);
+      w.Arr(ot);
+      break;
+    }
+    case kDenseFeature: {
+      int64_t n, nf, nd;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* fids = r.Arr<int32_t>(&nf);
+      const int32_t* dims = r.Arr<int32_t>(&nd);
+      int64_t row = 0;
+      for (int64_t k = 0; k < nd; ++k) row += dims[k];
+      std::vector<float> out(static_cast<size_t>(n * row));
+      if (r.ok() && nf == nd)
+        engine_.GetDenseFeature(ids, static_cast<int>(n), fids, dims,
+                                static_cast<int>(nf), out.data());
+      w.Arr(out);
+      break;
+    }
+    case kEdgeDenseFeature: {
+      int64_t n, n2, n3, nf, nd;
+      const uint64_t* src = r.Arr<uint64_t>(&n);
+      const uint64_t* dst = r.Arr<uint64_t>(&n2);
+      const int32_t* types = r.Arr<int32_t>(&n3);
+      const int32_t* fids = r.Arr<int32_t>(&nf);
+      const int32_t* dims = r.Arr<int32_t>(&nd);
+      int64_t row = 0;
+      for (int64_t k = 0; k < nd; ++k) row += dims[k];
+      std::vector<float> out(static_cast<size_t>(n * row));
+      if (r.ok() && n == n2 && n == n3 && nf == nd)
+        engine_.GetEdgeDenseFeature(src, dst, types, static_cast<int>(n),
+                                    fids, dims, static_cast<int>(nf),
+                                    out.data());
+      w.Arr(out);
+      break;
+    }
+    case kSparseFeature: {
+      int64_t n, nf;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok())
+        WriteResult(&w, engine_.GetSparseFeature(ids, static_cast<int>(n),
+                                                 fids, static_cast<int>(nf)));
+      break;
+    }
+    case kEdgeSparseFeature: {
+      int64_t n, n2, n3, nf;
+      const uint64_t* src = r.Arr<uint64_t>(&n);
+      const uint64_t* dst = r.Arr<uint64_t>(&n2);
+      const int32_t* types = r.Arr<int32_t>(&n3);
+      const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok() && n == n2 && n == n3)
+        WriteResult(&w, engine_.GetEdgeSparseFeature(
+                            src, dst, types, static_cast<int>(n), fids,
+                            static_cast<int>(nf)));
+      break;
+    }
+    case kBinaryFeature: {
+      int64_t n, nf;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok())
+        WriteResult(&w, engine_.GetBinaryFeature(ids, static_cast<int>(n),
+                                                 fids, static_cast<int>(nf)));
+      break;
+    }
+    case kEdgeBinaryFeature: {
+      int64_t n, n2, n3, nf;
+      const uint64_t* src = r.Arr<uint64_t>(&n);
+      const uint64_t* dst = r.Arr<uint64_t>(&n2);
+      const int32_t* types = r.Arr<int32_t>(&n3);
+      const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok() && n == n2 && n == n3)
+        WriteResult(&w, engine_.GetEdgeBinaryFeature(
+                            src, dst, types, static_cast<int>(n), fids,
+                            static_cast<int>(nf)));
+      break;
+    }
+    default: {
+      WireWriter e;
+      e.U8(1);
+      e.Str("unknown op " + std::to_string(op));
+      *reply = std::move(e.buf());
+      return;
+    }
+  }
+
+  if (!r.ok()) {
+    WireWriter e;
+    e.U8(1);
+    e.Str("malformed request for op " + std::to_string(op));
+    *reply = std::move(e.buf());
+    return;
+  }
+  *reply = std::move(w.buf());
+}
+
+}  // namespace eg

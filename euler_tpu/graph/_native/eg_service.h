@@ -1,0 +1,77 @@
+// Sharded graph service: serves one shard's Engine over TCP.
+//
+// Role equivalent of the reference's async gRPC server
+// (reference euler/service/graph_service.cc:112-168 — N completion queues ×
+// N threads of CallData state machines) re-shaped for the simpler wire
+// protocol: an accept loop + one handler thread per connection, each running
+// a read-decode-execute-reply loop. Clients multiplex by holding several
+// connections, so server-side concurrency = number of client connections —
+// the same effective model as CQ-per-core without the gRPC machinery.
+//
+// Discovery: instead of ZooKeeper ephemeral znodes
+// (reference euler/common/zk_server_register.cc:32-48 "<shard>#<ip:port>"
+// children), the service drops a registry file "<shard>#<host>_<port>" into
+// a shared directory (atomic rename; removed on Stop). On a TPU pod the
+// natural registry_dir is on the shared filesystem all hosts mount.
+#ifndef EG_SERVICE_H_
+#define EG_SERVICE_H_
+
+#include <atomic>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "eg_engine.h"
+
+namespace eg {
+
+// Count partitions in a data dir: max "*_<p>.dat" index + 1 (files without a
+// partition suffix count as partition 0). Matches the shard->partition map
+// of reference euler/core/graph_engine.cc:90-107.
+int CountPartitions(const std::string& dir);
+
+class Service {
+ public:
+  ~Service() { Stop(); }
+
+  // Loads shard `shard_idx` of `shard_num` from data_dir, binds host:port
+  // (port 0 = ephemeral) and starts serving. If registry_dir is non-empty,
+  // registers there. False + error() on failure.
+  bool Start(const std::string& data_dir, int shard_idx, int shard_num,
+             const std::string& host, int port,
+             const std::string& registry_dir);
+  void Stop();
+
+  int port() const { return port_; }
+  int shard_idx() const { return shard_idx_; }
+  const std::string& error() const { return error_; }
+  const Engine& engine() const { return engine_; }
+
+ private:
+  void AcceptLoop();
+  void HandleConn(int fd);
+  // Decode one request, run it on the engine, encode the reply.
+  void Dispatch(const std::string& req, std::string* reply) const;
+
+  Engine engine_;
+  std::string error_;
+  std::string host_;
+  int port_ = 0;
+  int shard_idx_ = 0, shard_num_ = 1, num_partitions_ = 1;
+  std::string registry_file_;
+
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex mu_;  // guards conn_fds_
+  std::set<int> conn_fds_;
+  // Handler threads are detached; Stop() waits for this to drain so no
+  // handler can outlive the Service it references.
+  std::atomic<int> active_conns_{0};
+};
+
+}  // namespace eg
+
+#endif  // EG_SERVICE_H_

@@ -1,0 +1,168 @@
+// Binary wire protocol for the sharded graph service.
+//
+// Role equivalent of the reference's protobuf wire layer
+// (reference euler/proto/graph_service.proto: 13 RPCs with flat id/weight
+// array replies) — redesigned as a zero-dependency length-prefixed binary
+// protocol: requests and replies are flat little-endian arrays, so
+// marshaling is memcpy-shaped (the reference's §3.5 hot loop #3 is gRPC
+// serialize/deserialize of exactly such arrays).
+//
+// Frame:   [u32 payload_len][payload]
+// Request: payload = [u8 op][args...]
+// Reply:   payload = [u8 status][body...]   status 0 = ok, else error string.
+#ifndef EG_WIRE_H_
+#define EG_WIRE_H_
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace eg {
+
+// Op codes — one per GraphService RPC (reference graph_service.proto:13-25;
+// sorted-ness is a flag on kFullNeighbor, dense == Float32 feature).
+enum WireOp : uint8_t {
+  kPing = 1,
+  kInfo = 2,
+  kSampleNode = 3,
+  kSampleEdge = 4,
+  kNodeType = 5,
+  kSampleNeighbor = 6,
+  kFullNeighbor = 7,
+  kTopKNeighbor = 8,
+  kDenseFeature = 9,
+  kEdgeDenseFeature = 10,
+  kSparseFeature = 11,
+  kEdgeSparseFeature = 12,
+  kBinaryFeature = 13,
+  kEdgeBinaryFeature = 14,
+};
+
+constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap
+
+class WireWriter {
+ public:
+  std::string& buf() { return buf_; }
+
+  void U8(uint8_t v) { buf_.push_back(static_cast<char>(v)); }
+  template <typename T>
+  void Pod(T v) {
+    buf_.append(reinterpret_cast<const char*>(&v), sizeof(T));
+  }
+  void I32(int32_t v) { Pod(v); }
+  void I64(int64_t v) { Pod(v); }
+  void U64(uint64_t v) { Pod(v); }
+  void F32(float v) { Pod(v); }
+
+  template <typename T>
+  void Arr(const T* p, int64_t n) {
+    I64(n);
+    if (n) buf_.append(reinterpret_cast<const char*>(p), n * sizeof(T));
+  }
+  template <typename T>
+  void Arr(const std::vector<T>& v) {
+    Arr(v.data(), static_cast<int64_t>(v.size()));
+  }
+  void Str(const std::string& s) {
+    I64(static_cast<int64_t>(s.size()));
+    buf_.append(s);
+  }
+
+ private:
+  std::string buf_;
+};
+
+class WireReader {
+ public:
+  WireReader(const char* p, size_t n) : p_(p), n_(n) {}
+  explicit WireReader(const std::string& s) : p_(s.data()), n_(s.size()) {}
+
+  bool ok() const { return ok_; }
+
+  uint8_t U8() {
+    uint8_t v = 0;
+    Copy(&v, 1);
+    return v;
+  }
+  template <typename T>
+  T Pod() {
+    T v{};
+    Copy(&v, sizeof(T));
+    return v;
+  }
+  int32_t I32() { return Pod<int32_t>(); }
+  int64_t I64() { return Pod<int64_t>(); }
+  uint64_t U64() { return Pod<uint64_t>(); }
+  float F32() { return Pod<float>(); }
+
+  // View of a length-prefixed array; nullptr on underrun. Zero-copy when
+  // the payload offset happens to be aligned for T; otherwise the data is
+  // memcpy'd into an owned 8-byte-aligned scratch block (offsets after the
+  // leading status/op byte are usually odd, so replies typically take the
+  // copy path — still one copy, same as protobuf parsing).
+  template <typename T>
+  const T* Arr(int64_t* n) {
+    *n = I64();
+    size_t bytes = static_cast<size_t>(*n) * sizeof(T);
+    if (!ok_ || *n < 0 || bytes > n_ - off_) {
+      ok_ = false;
+      *n = 0;
+      return nullptr;
+    }
+    const char* raw = p_ + off_;
+    off_ += bytes;
+    if (reinterpret_cast<uintptr_t>(raw) % alignof(T) == 0)
+      return reinterpret_cast<const T*>(raw);
+    auto buf = std::make_unique<std::vector<uint64_t>>((bytes + 7) / 8);
+    std::memcpy(buf->data(), raw, bytes);
+    const T* p = reinterpret_cast<const T*>(buf->data());
+    scratch_.push_back(std::move(buf));
+    return p;
+  }
+  template <typename T>
+  void Vec(std::vector<T>* out) {
+    int64_t n;
+    const T* p = Arr<T>(&n);
+    out->assign(p, p + n);
+  }
+  std::string Str() {
+    int64_t n;
+    const char* p = Arr<char>(&n);
+    return std::string(p ? p : "", static_cast<size_t>(n));
+  }
+
+ private:
+  void Copy(void* out, size_t sz) {
+    if (sz > n_ - off_) {
+      ok_ = false;
+      std::memset(out, 0, sz);
+      return;
+    }
+    std::memcpy(out, p_ + off_, sz);
+    off_ += sz;
+  }
+
+  const char* p_;
+  size_t n_;
+  size_t off_ = 0;
+  bool ok_ = true;
+  std::vector<std::unique_ptr<std::vector<uint64_t>>> scratch_;
+};
+
+// ---- framed socket IO (implemented in eg_wire.cc) ----
+
+// Write [u32 len][payload]; false on error.
+bool SendFrame(int fd, const std::string& payload);
+// Read one frame into *payload; false on error/close/oversize.
+bool RecvFrame(int fd, std::string* payload);
+// Blocking TCP connect with send/recv timeouts + TCP_NODELAY; -1 on failure.
+int DialTcp(const std::string& host, int port, int timeout_ms);
+// Listen socket on host:port (port 0 = ephemeral); *bound_port receives the
+// actual port. -1 on failure.
+int ListenTcp(const std::string& host, int port, int* bound_port);
+
+}  // namespace eg
+
+#endif  // EG_WIRE_H_

@@ -47,7 +47,16 @@ def _default_u64(default_node: int) -> int:
 
 
 class Graph:
-    """An embedded (in-process) graph engine over .dat partitions."""
+    """Graph client: embedded engine (mode='local') or sharded remote
+    client (mode='remote').
+
+    Mode selection mirrors the reference factory Graph::NewGraph
+    (reference euler/client/graph.cc:157-185): local embeds the engine
+    in-process; remote discovers shards from a flat-file ``registry``
+    directory (written by :class:`euler_tpu.graph.GraphService`) or an
+    explicit ``shards`` list, routes ids shard(id) = (id % P) % S, and
+    merges scatter/gather replies — all in native code (eg_remote.cc).
+    """
 
     def __init__(
         self,
@@ -55,8 +64,37 @@ class Graph:
         files: list[str] | None = None,
         shard_idx: int = 0,
         shard_num: int = 1,
+        mode: str = "local",
+        registry: str | None = None,
+        shards: list[str] | list[list[str]] | None = None,
+        retries: int = 3,
+        timeout_ms: int = 5000,
+        quarantine_ms: int = 3000,
     ):
         self._lib = lib()
+        if mode not in ("local", "remote"):
+            raise ValueError("mode must be 'local' or 'remote'")
+        self.mode = mode
+        if mode == "remote":
+            if registry:
+                conf = f"registry={registry}"
+            elif shards:
+                # each entry: an address, or a list of replica addresses
+                parts = [
+                    s if isinstance(s, str) else "|".join(s) for s in shards
+                ]
+                conf = "shards=" + ",".join(parts)
+            else:
+                raise ValueError("remote mode needs registry= or shards=")
+            conf += (
+                f";retries={retries};timeout_ms={timeout_ms}"
+                f";quarantine_ms={quarantine_ms}"
+            )
+            self._h = self._lib.eg_remote_create(conf.encode())
+            if not self._h:
+                err = self._lib.eg_last_error().decode()
+                raise RuntimeError(f"remote graph init failed: {err}")
+            return
         self._h = self._lib.eg_create()
         if directory is not None:
             rc = self._lib.eg_load(
@@ -72,6 +110,20 @@ class Graph:
             self._lib.eg_destroy(self._h)
             self._h = None
             raise RuntimeError(f"graph load failed: {err}")
+
+    @property
+    def num_shards(self) -> int:
+        return (
+            self._lib.eg_remote_shards(self._h) if self.mode == "remote" else 1
+        )
+
+    @property
+    def num_partitions(self) -> int:
+        return (
+            self._lib.eg_remote_partitions(self._h)
+            if self.mode == "remote"
+            else 1
+        )
 
     def close(self) -> None:
         if getattr(self, "_h", None):

@@ -53,6 +53,16 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_load, c.c_int, [p, c.c_char_p, c.c_int, c.c_int])
     _sig(L.eg_load_files, c.c_int, [p, c.POINTER(c.c_char_p), c.c_int])
     _sig(L.eg_seed, None, [c.c_uint64])
+    _sig(L.eg_remote_create, p, [c.c_char_p])
+    _sig(L.eg_remote_shards, c.c_int, [p])
+    _sig(L.eg_remote_partitions, c.c_int, [p])
+    _sig(
+        L.eg_service_start,
+        p,
+        [c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_char_p],
+    )
+    _sig(L.eg_service_port, c.c_int, [p])
+    _sig(L.eg_service_stop, None, [p])
     _sig(L.eg_num_nodes, c.c_int64, [p])
     _sig(L.eg_num_edges, c.c_int64, [p])
     _sig(L.eg_node_type_num, c.c_int32, [p])

@@ -1,0 +1,110 @@
+"""Graph-service shard launcher.
+
+Role equivalent of the reference's service launcher
+(reference euler/python/service.py:30-50, which ctypes-loads
+libeuler_service.so and runs StartService on a daemon thread): here the
+native Service (eg_service.cc) runs its own accept/handler threads, so
+``GraphService(...)`` returns as soon as the shard has loaded its partitions
+and bound its port. Discovery is a flat-file registry directory instead of
+ZooKeeper (see eg_service.h) — on a multi-host TPU pod, point every host at
+the same shared-filesystem registry dir.
+
+Also runnable as a standalone shard process:
+    python -m euler_tpu.graph.service --data_dir d --shard_idx 0 \
+        --shard_num 2 --port 9001 --registry /shared/reg
+"""
+
+from __future__ import annotations
+
+from euler_tpu.graph.native import lib
+
+
+class GraphService:
+    """One graph shard served over TCP; stops on close() or GC."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        shard_idx: int = 0,
+        shard_num: int = 1,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry: str | None = None,
+    ):
+        self._lib = lib()
+        self._h = self._lib.eg_service_start(
+            data_dir.encode(),
+            shard_idx,
+            shard_num,
+            host.encode(),
+            port,
+            (registry or "").encode(),
+        )
+        if not self._h:
+            err = self._lib.eg_last_error().decode()
+            raise RuntimeError(f"graph service start failed: {err}")
+        self.host = host
+        self.port = self._lib.eg_service_port(self._h)
+        self.shard_idx = shard_idx
+        self.shard_num = shard_num
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.eg_service_stop(self._h)
+            self._h = None
+
+    close = stop
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def main() -> None:
+    import argparse
+    import signal
+    import time
+
+    ap = argparse.ArgumentParser(description="Run one graph-service shard.")
+    ap.add_argument("--data_dir", required=True)
+    ap.add_argument("--shard_idx", type=int, default=0)
+    ap.add_argument("--shard_num", type=int, default=1)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--registry", default=None)
+    args = ap.parse_args()
+    svc = GraphService(
+        args.data_dir,
+        args.shard_idx,
+        args.shard_num,
+        args.host,
+        args.port,
+        args.registry,
+    )
+    print(
+        f"graph shard {svc.shard_idx}/{svc.shard_num} serving on"
+        f" {svc.address}",
+        flush=True,
+    )
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    svc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,7 @@ class _LsHNEModule(nn.Module):
     sparse_feature_dims: Sequence[int]
     feature_embedding_dim: int = 16
     hidden_dim: int = 256
+    gamma: float = 5.0
 
     def setup(self):
         self.feature_embeddings = [
@@ -134,7 +135,9 @@ class _LsHNEModule(nn.Module):
         lshne.py:140-161). emb/emb_pos [B, d]; emb_negs [B, negs, d]."""
         pos_cos = _cosine(emb, emb_pos)  # [B, 1]
         neg_cos = _cosine(emb[:, None, :], emb_negs)[..., 0]  # [B, negs]
-        logits = jnp.concatenate([pos_cos, neg_cos], axis=-1)
+        # gamma tempers the [-1,1] cosine range before the softmax so the
+        # positive can dominate (reference lshne.py decoder scaling).
+        logits = self.gamma * jnp.concatenate([pos_cos, neg_cos], axis=-1)
         logp = nn.log_softmax(logits, axis=-1)
         per_pair = -logp[:, 0]
         loss = jnp.sum(per_pair * mask)
@@ -216,6 +219,7 @@ class LsHNE(base.Model):
             src_type_num=src_type_num,
             sparse_feature_dims=tuple(sparse_feature_dims),
             feature_embedding_dim=feature_embedding_dim,
+            gamma=gamma,
         )
 
     def _node_inputs(self, graph, ids: np.ndarray) -> dict:
