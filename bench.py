@@ -41,51 +41,16 @@ MEASURE = 30
 
 def build_synthetic_graph(cache_dir: str) -> str:
     """Write a synthetic PPI-scale graph as .dat partitions (cached)."""
-    os.makedirs(cache_dir, exist_ok=True)
-    marker = os.path.join(cache_dir, "done")
-    if os.path.exists(marker):
-        return cache_dir
-    import euler_tpu
+    from euler_tpu.datasets import build_synthetic
 
-    rng = np.random.default_rng(7)
-    meta = {
-        "node_type_num": 1,
-        "edge_type_num": 1,
-        "node_uint64_feature_num": 0,
-        "node_float_feature_num": 2,
-        "node_binary_feature_num": 0,
-        "edge_uint64_feature_num": 0,
-        "edge_float_feature_num": 0,
-        "edge_binary_feature_num": 0,
-    }
-    paths = ["%s/part_%d.dat" % (cache_dir, p) for p in range(4)]
-    outs = [open(p, "wb") for p in paths]
-    from euler_tpu.graph.convert import pack_block
-
-    degrees = rng.poisson(AVG_DEGREE, NUM_NODES).clip(1, 60)
-    for nid in range(NUM_NODES):
-        nbrs = rng.integers(0, NUM_NODES, degrees[nid])
-        node = {
-            "node_id": nid,
-            "node_type": 0,
-            "node_weight": 1.0,
-            "neighbor": {
-                "0": {str(int(d)): 1.0 for d in nbrs},
-            },
-            "uint64_feature": {},
-            "float_feature": {
-                # slot 0: labels (121 multi-hot), slot 1: features (50)
-                "0": rng.integers(0, 2, LABEL_DIM).astype(float).tolist(),
-                "1": rng.standard_normal(FEATURE_DIM).round(3).tolist(),
-            },
-            "binary_feature": {},
-            "edge": [],
-        }
-        outs[nid % 4].write(pack_block(node, meta))
-    for o in outs:
-        o.close()
-    open(marker, "w").write("ok")
-    return cache_dir
+    return build_synthetic(
+        cache_dir,
+        num_nodes=NUM_NODES,
+        avg_degree=AVG_DEGREE,
+        feature_dim=FEATURE_DIM,
+        label_dim=LABEL_DIM,
+        multilabel=True,
+    )
 
 
 def main() -> None:

@@ -1,0 +1,8 @@
+"""``python -m euler_tpu`` — the training CLI (reference
+``python -m tf_euler``, tf_euler/python/__main__.py -> run_loop.main)."""
+
+import sys
+
+from euler_tpu.run_loop import main
+
+sys.exit(main())

@@ -1,0 +1,77 @@
+"""Checkpoint / resume for training state.
+
+Role equivalent of the reference's delegation to
+tf.train.MonitoredTrainingSession(checkpoint_dir=...) (reference
+tf_euler/python/run_loop.py:132-138): periodic save of the full training
+state (params + optimizer state) with automatic resume from the latest
+step on restart. Built on orbax, the JAX-native checkpointer — state is a
+pytree of (possibly sharded) jax.Arrays, saved asynchronously so the train
+loop does not stall. Graph data itself is never checkpointed: like the
+reference, the store is an immutable input (SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _manager(ckpt_dir: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+class Checkpointer:
+    """Periodic saver + latest-step restorer over one directory."""
+
+    def __init__(self, ckpt_dir: str, max_to_keep: int = 3):
+        self.dir = os.path.abspath(ckpt_dir)
+        self._mngr = _manager(ckpt_dir, max_to_keep)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def save(self, step: int, state: Any, force: bool = False) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure of state_like (an initialized state
+        pytree — shapes/dtypes/shardings are taken from it)."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.dir}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(x),
+                x.dtype if hasattr(x, "dtype") else np.asarray(x).dtype,
+                sharding=getattr(x, "sharding", None),
+            ),
+            state_like,
+        )
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+
+    def wait(self) -> None:
+        """Block until async saves complete (call before process exit)."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mngr.close()

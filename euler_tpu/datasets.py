@@ -1,0 +1,113 @@
+"""Synthetic benchmark datasets at reference scales.
+
+The reference's data prep (reference examples/ppi_data.py:40-150 downloads
+GraphSAGE-format PPI; reddit_data.py:42-58 converts DGL's reddit npz) pulls
+real datasets over the network; this environment has zero egress, so these
+generators emit synthetic graphs with the SAME scale constants (node count,
+degree, feature/label dims — reference tf_euler/python/ppi_main.py:24-33 and
+reddit_main.py:24-34) and the same .dat layout, making sampling + compute
+cost representative while remaining fully reproducible.
+
+Layout convention (matches the examples' training flags):
+  float_feature slot 0 = labels (multi-/one-hot), slot 1 = input features.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+PPI = dict(num_nodes=56944, avg_degree=15, feature_dim=50, label_dim=121,
+           multilabel=True)
+REDDIT = dict(num_nodes=232965, avg_degree=50, feature_dim=602, label_dim=41,
+              multilabel=False)
+
+
+def build_synthetic(
+    out_dir: str,
+    num_nodes: int,
+    avg_degree: int,
+    feature_dim: int,
+    label_dim: int,
+    multilabel: bool = True,
+    num_partitions: int = 4,
+    max_degree: int = 60,
+    seed: int = 7,
+) -> str:
+    """Write a synthetic graph as .dat partitions + meta.json (cached: a
+    'done' marker records the generation params and skips regeneration only
+    when they match). Returns out_dir."""
+    os.makedirs(out_dir, exist_ok=True)
+    params = json.dumps(
+        dict(num_nodes=num_nodes, avg_degree=avg_degree,
+             feature_dim=feature_dim, label_dim=label_dim,
+             multilabel=multilabel, num_partitions=num_partitions,
+             max_degree=max_degree, seed=seed),
+        sort_keys=True,
+    )
+    marker = os.path.join(out_dir, "done")
+    if os.path.exists(marker):
+        with open(marker) as f:
+            if f.read() == params:
+                return out_dir
+        # stale cache generated with different settings: rebuild
+        for name in os.listdir(out_dir):
+            if name.endswith(".dat") or name in ("done", "meta.json"):
+                os.unlink(os.path.join(out_dir, name))
+    from euler_tpu.graph.convert import pack_block
+
+    rng = np.random.default_rng(seed)
+    meta = {
+        "node_type_num": 1,
+        "edge_type_num": 1,
+        "node_uint64_feature_num": 0,
+        "node_float_feature_num": 2,
+        "node_binary_feature_num": 0,
+        "edge_uint64_feature_num": 0,
+        "edge_float_feature_num": 0,
+        "edge_binary_feature_num": 0,
+    }
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    paths = [
+        os.path.join(out_dir, "part_%d.dat" % p)
+        for p in range(num_partitions)
+    ]
+    outs = [open(p, "wb") for p in paths]
+    degrees = rng.poisson(avg_degree, num_nodes).clip(1, max_degree)
+    for nid in range(num_nodes):
+        nbrs = rng.integers(0, num_nodes, degrees[nid])
+        if multilabel:
+            labels = rng.integers(0, 2, label_dim).astype(float)
+        else:
+            labels = np.zeros(label_dim)
+            labels[rng.integers(0, label_dim)] = 1.0
+        node = {
+            "node_id": nid,
+            "node_type": 0,
+            "node_weight": 1.0,
+            "neighbor": {"0": {str(int(d)): 1.0 for d in nbrs}},
+            "uint64_feature": {},
+            "float_feature": {
+                "0": labels.tolist(),
+                "1": rng.standard_normal(feature_dim).round(3).tolist(),
+            },
+            "binary_feature": {},
+            "edge": [],
+        }
+        outs[nid % num_partitions].write(pack_block(node, meta))
+    for o in outs:
+        o.close()
+    with open(marker, "w") as f:
+        f.write(params)
+    return out_dir
+
+
+def build_ppi(out_dir: str, **overrides) -> str:
+    return build_synthetic(out_dir, **{**PPI, **overrides})
+
+
+def build_reddit(out_dir: str, **overrides) -> str:
+    return build_synthetic(out_dir, **{**REDDIT, **overrides})

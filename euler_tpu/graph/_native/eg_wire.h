@@ -105,12 +105,16 @@ class WireReader {
   template <typename T>
   const T* Arr(int64_t* n) {
     *n = I64();
-    size_t bytes = static_cast<size_t>(*n) * sizeof(T);
-    if (!ok_ || *n < 0 || bytes > n_ - off_) {
+    // Divide instead of multiplying: n * sizeof(T) can wrap for a hostile
+    // length, which would pass the underrun check and then explode in the
+    // caller's vector allocation.
+    if (!ok_ || *n < 0 ||
+        static_cast<uint64_t>(*n) > (n_ - off_) / sizeof(T)) {
       ok_ = false;
       *n = 0;
       return nullptr;
     }
+    size_t bytes = static_cast<size_t>(*n) * sizeof(T);
     const char* raw = p_ + off_;
     off_ += bytes;
     if (reinterpret_cast<uintptr_t>(raw) % alignof(T) == 0)

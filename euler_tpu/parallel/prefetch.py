@@ -19,12 +19,17 @@ def prefetch(
     num_steps: int,
     depth: int = 2,
     num_threads: int = 2,
+    start: int = 0,
 ) -> Iterator[dict]:
-    """Yield num_steps batches, produced ahead of time by worker threads.
+    """Yield num_steps batches for steps start..start+num_steps, produced
+    ahead of time by worker threads.
 
     make_batch(step) must be thread-safe (the graph engine is: the store is
     immutable and RNG is thread-local).
     """
+    if start:
+        base_make = make_batch
+        make_batch = lambda step: base_make(step + start)  # noqa: E731
     if num_threads <= 1 or depth <= 0:
         for step in range(num_steps):
             yield make_batch(step)

@@ -1,0 +1,490 @@
+"""Training / evaluation / embedding-export CLI driver.
+
+Reference equivalent: tf_euler/python/run_loop.py — same flag surface
+(:36-92), same model names in the dispatch (:222-354), same three modes
+(train :95-140, evaluate :143-171, save_embedding :174-219) — rebuilt for
+the TPU stack:
+
+* ``MonitoredTrainingSession`` -> euler_tpu.train.train (jitted step,
+  orbax checkpoints in --model_dir, resume-from-latest).
+* PS/worker ClusterSpec (run_loop.py:371-397) -> one process per TPU host
+  with jax.distributed (--coordinator_addr/--num_processes/--process_id);
+  within a process, data parallelism over the local device mesh.
+* ``initialize_shared_graph`` (tf_euler base.py:64) -> --graph_mode=shared:
+  every process serves its graph shard (GraphService) and connects a
+  remote client over the flat-file --registry.
+
+Usage:  python -m euler_tpu --data_dir ... --model graphsage_supervised ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+import euler_tpu
+from euler_tpu import models
+from euler_tpu.parallel import make_mesh
+from euler_tpu import train as train_lib
+
+log = logging.getLogger("euler_tpu")
+
+
+def _str2bool(v: str) -> bool:
+    return str(v).lower() in ("1", "true", "yes", "y")
+
+
+def _int_list(v) -> list[int]:
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(x) for x in str(v).split(",") if x != ""]
+
+
+def define_flags(parser: Optional[argparse.ArgumentParser] = None):
+    """Flag surface of reference run_loop.py:36-92 (ZK flags replaced by
+    the flat-file registry; PS flags by jax.distributed)."""
+    p = parser or argparse.ArgumentParser(prog="euler_tpu")
+    p.add_argument(
+        "--mode",
+        default="train",
+        choices=["train", "evaluate", "save_embedding"],
+    )
+    # graph
+    p.add_argument("--data_dir", default="")
+    p.add_argument("--graph_mode", default="local",
+                   choices=["local", "remote", "shared"])
+    p.add_argument("--registry", default="")
+    p.add_argument("--shards", default="",
+                   help="comma list of host:port (remote mode)")
+    p.add_argument("--train_node_type", type=int, default=0)
+    p.add_argument("--all_node_type", type=int, default=-1)
+    p.add_argument("--train_edge_type", default="0")
+    p.add_argument("--all_edge_type", default="0,1,2")
+    p.add_argument("--max_id", type=int, default=-1)
+    p.add_argument("--feature_idx", type=int, default=-1)
+    p.add_argument("--feature_dim", type=int, default=0)
+    p.add_argument("--label_idx", type=int, default=-1)
+    p.add_argument("--label_dim", type=int, default=0)
+    p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--id_file", default="")
+    # model
+    p.add_argument("--model", default="graphsage_supervised")
+    p.add_argument("--sigmoid_loss", type=_str2bool, default=True)
+    p.add_argument("--xent_loss", type=_str2bool, default=True)
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--num_negs", type=int, default=5)
+    p.add_argument("--order", type=int, default=1)
+    p.add_argument("--walk_len", type=int, default=5)
+    p.add_argument("--walk_p", type=float, default=1.0)
+    p.add_argument("--walk_q", type=float, default=1.0)
+    p.add_argument("--left_win_size", type=int, default=5)
+    p.add_argument("--right_win_size", type=int, default=5)
+    p.add_argument("--fanouts", default="10,10")
+    p.add_argument(
+        "--aggregator",
+        default="mean",
+        choices=["gcn", "mean", "meanpool", "maxpool", "attention"],
+    )
+    p.add_argument("--concat", type=_str2bool, default=True)
+    p.add_argument("--use_residual", type=_str2bool, default=False)
+    p.add_argument("--store_learning_rate", type=float, default=0.001)
+    p.add_argument("--store_init_maxval", type=float, default=0.05)
+    p.add_argument("--head_num", type=int, default=1)
+    p.add_argument("--embedding_file", default="",
+                   help="embedding.npy for model=saved_embedding "
+                        "(default: <model_dir>/embedding.npy)")
+    # training
+    p.add_argument("--model_dir", default="ckpt")
+    p.add_argument("--batch_size", type=int, default=512)
+    p.add_argument("--optimizer", default="adam",
+                   choices=sorted(train_lib.OPTIMIZERS))
+    p.add_argument("--learning_rate", type=float, default=0.01)
+    p.add_argument("--num_epochs", type=int, default=20)
+    p.add_argument("--log_steps", type=int, default=20)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--num_devices", type=int, default=None,
+                   help="devices in the data-parallel mesh (default: all)")
+    p.add_argument("--prefetch_depth", type=int, default=2)
+    p.add_argument("--prefetch_threads", type=int, default=2)
+    p.add_argument("--profile_dir", default="")
+    # multi-process (multi-host TPU) — replaces PS/worker flags
+    p.add_argument("--coordinator_addr", default="")
+    p.add_argument("--num_processes", type=int, default=1)
+    p.add_argument("--process_id", type=int, default=0)
+    return p
+
+
+def build_graph(args):
+    """Local / remote / shared graph init (reference tf_euler base.py:35-91:
+    initialize_graph / initialize_shared_graph)."""
+    services = []
+    if args.graph_mode == "local":
+        graph = euler_tpu.Graph(directory=args.data_dir)
+    elif args.graph_mode == "remote":
+        graph = euler_tpu.Graph(
+            mode="remote",
+            registry=args.registry or None,
+            shards=args.shards.split(",") if args.shards else None,
+        )
+    else:  # shared: serve this process's shard, then connect remote
+        if not args.registry:
+            raise ValueError("--graph_mode=shared needs --registry")
+        services.append(
+            euler_tpu.GraphService(
+                args.data_dir,
+                shard_idx=args.process_id,
+                shard_num=args.num_processes,
+                registry=args.registry,
+            )
+        )
+        # Wait for every shard to register before connecting. Only count
+        # well-formed "<shard>#..." entries, and fail loudly on timeout —
+        # stale entries from a SIGKILLed run also surface here as a clear
+        # error instead of a confusing connect failure later.
+        import time
+
+        deadline = time.time() + 120.0
+        while True:
+            entries = {
+                f.split("#", 1)[0]
+                for f in os.listdir(args.registry)
+                if "#" in f
+            }
+            if len(entries) >= args.num_processes:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"only shards {sorted(entries)} registered in "
+                    f"{args.registry} after 120s "
+                    f"(need {args.num_processes})"
+                )
+            time.sleep(0.1)
+        graph = euler_tpu.Graph(mode="remote", registry=args.registry)
+    return graph, services
+
+
+class SavedEmbedding(models.Model):
+    """Frozen saved-embedding encoder + trainable classifier
+    (reference run_loop.py:340-351)."""
+
+    metric_name = "f1"
+
+    def __init__(self, embedding: np.ndarray, label_idx, label_dim,
+                 num_classes=None, sigmoid_loss=True):
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        super().__init__()
+        self.embedding = embedding.astype(np.float32)
+        self.label_idx = label_idx
+        self.label_dim = label_dim
+        outer = self
+
+        class _Module(nn.Module):
+            @nn.compact
+            def __call__(self, batch):
+                logits = nn.Dense(num_classes or label_dim)(
+                    jax.lax.stop_gradient(batch["emb"])
+                )
+                loss, preds = models.base.supervised_decoder(
+                    logits, batch["labels"], sigmoid_loss
+                )
+                from euler_tpu.nn import metrics as m
+
+                return models.ModelOutput(
+                    embedding=batch["emb"],
+                    loss=loss,
+                    metric_name="f1",
+                    metric=m.f1_counts(batch["labels"], preds),
+                )
+
+            def embed(self, batch):
+                return batch["emb"]
+
+        import jax
+
+        self.module = _Module()
+
+    def sample(self, graph, inputs):
+        ids = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        safe = np.clip(ids, 0, len(self.embedding) - 1)
+        return {
+            "emb": self.embedding[safe],
+            "labels": graph.get_dense_feature(
+                ids, [self.label_idx], [self.label_dim]
+            ),
+        }
+
+
+def build_model(args, graph):
+    """Model dispatch with the reference's model names
+    (reference run_loop.py:222-354)."""
+    fanouts = _int_list(args.fanouts)
+    train_edge = _int_list(args.train_edge_type)
+    all_edge = _int_list(args.all_edge_type)
+    metapath = [list(train_edge if args.mode == "train" else all_edge)] * max(
+        len(fanouts), 1
+    )
+    name = args.model
+    common_sup = dict(
+        label_idx=args.label_idx,
+        label_dim=args.label_dim,
+        num_classes=args.num_classes,
+        sigmoid_loss=args.sigmoid_loss,
+        feature_idx=args.feature_idx,
+        feature_dim=args.feature_dim,
+    )
+    if name == "line":
+        return models.LINE(
+            node_type=args.all_node_type,
+            edge_type=all_edge,
+            max_id=args.max_id,
+            dim=args.dim,
+            xent_loss=args.xent_loss,
+            num_negs=args.num_negs,
+            order=args.order,
+        )
+    if name in ("randomwalk", "deepwalk", "node2vec"):
+        return models.Node2Vec(
+            node_type=args.all_node_type,
+            edge_type=all_edge,
+            max_id=args.max_id,
+            dim=args.dim,
+            xent_loss=args.xent_loss,
+            num_negs=args.num_negs,
+            walk_len=args.walk_len,
+            walk_p=args.walk_p,
+            walk_q=args.walk_q,
+            left_win_size=args.left_win_size,
+            right_win_size=args.right_win_size,
+        )
+    if name in ("gcn", "gcn_supervised"):
+        # Full-neighbor GCN needs per-hop dense caps for static shapes.
+        cap = max(fanouts) if fanouts else 10
+        return models.SupervisedGCN(
+            metapath=metapath,
+            dim=args.dim,
+            max_nodes_per_hop=[args.batch_size * (cap**h) for h in
+                               range(1, len(metapath) + 1)],
+            max_edges_per_hop=[args.batch_size * (cap ** (h + 1)) for h in
+                               range(len(metapath))],
+            aggregator=args.aggregator,
+            max_id=args.max_id,
+            use_residual=args.use_residual,
+            **common_sup,
+        )
+    if name == "scalable_gcn":
+        return models.ScalableGCN(
+            edge_type=metapath[0],
+            num_layers=len(fanouts),
+            dim=args.dim,
+            max_id=args.max_id,
+            # dense cap on the batch's unique 1-hop neighborhood
+            max_neighbors=args.batch_size * fanouts[0],
+            aggregator=args.aggregator,
+            use_residual=args.use_residual,
+            store_learning_rate=args.store_learning_rate,
+            store_init_maxval=args.store_init_maxval,
+            **common_sup,
+        )
+    if name == "graphsage":
+        return models.GraphSage(
+            node_type=args.train_node_type,
+            edge_type=train_edge,
+            max_id=args.max_id,
+            xent_loss=args.xent_loss,
+            num_negs=args.num_negs,
+            metapath=metapath,
+            fanouts=fanouts,
+            dim=args.dim,
+            aggregator=args.aggregator,
+            concat=args.concat,
+            feature_idx=args.feature_idx,
+            feature_dim=args.feature_dim,
+        )
+    if name == "graphsage_supervised":
+        return models.SupervisedGraphSage(
+            metapath=metapath,
+            fanouts=fanouts,
+            dim=args.dim,
+            aggregator=args.aggregator,
+            concat=args.concat,
+            max_id=args.max_id,
+            **common_sup,
+        )
+    if name == "scalable_sage":
+        return models.ScalableSage(
+            edge_type=metapath[0],
+            fanout=fanouts[0],
+            num_layers=len(fanouts),
+            dim=args.dim,
+            aggregator=args.aggregator,
+            concat=args.concat,
+            max_id=args.max_id,
+            store_learning_rate=args.store_learning_rate,
+            store_init_maxval=args.store_init_maxval,
+            **common_sup,
+        )
+    if name == "gat":
+        return models.GAT(
+            label_idx=args.label_idx,
+            label_dim=args.label_dim,
+            num_classes=args.num_classes,
+            sigmoid_loss=args.sigmoid_loss,
+            feature_idx=args.feature_idx,
+            feature_dim=args.feature_dim,
+            max_id=args.max_id,
+            head_num=args.head_num,
+            hidden_dim=args.dim,
+            nb_num=5,
+        )
+    if name == "lshne":
+        return models.LsHNE(
+            node_type=-1,
+            path_patterns=[[[0, 0, 0], [0, 0, 0]]],
+            max_id=args.max_id,
+            dim=128,
+            sparse_feature_dims=[args.max_id + 2],
+            feature_ids=[args.feature_idx if args.feature_idx >= 0 else 0],
+        )
+    if name == "saved_embedding":
+        emb = np.load(
+            args.embedding_file
+            or os.path.join(args.model_dir, "embedding.npy")
+        )
+        return SavedEmbedding(
+            emb,
+            args.label_idx,
+            args.label_dim,
+            args.num_classes,
+            args.sigmoid_loss,
+        )
+    raise ValueError(f"unsupported model {name!r}")
+
+
+def _num_steps(args) -> int:
+    per_epoch = max((args.max_id + 1) // args.batch_size, 1)
+    return per_epoch * args.num_epochs
+
+
+def run_train(model, graph, args, mesh):
+    batch = args.batch_size * getattr(model, "batch_size_ratio", 1)
+
+    def source_fn(step):
+        return np.asarray(graph.sample_node(batch, args.train_node_type))
+
+    state, history = train_lib.train(
+        model,
+        graph,
+        source_fn,
+        num_steps=_num_steps(args),
+        optimizer=args.optimizer,
+        learning_rate=args.learning_rate,
+        mesh=mesh,
+        log_every=args.log_steps,
+        seed=args.seed,
+        prefetch_depth=args.prefetch_depth,
+        prefetch_threads=args.prefetch_threads,
+        checkpoint_dir=args.model_dir or None,
+        profile_dir=args.profile_dir or None,
+    )
+    return state, history
+
+
+def _restore_state(model, graph, args, mesh):
+    import jax
+
+    from euler_tpu.checkpoint import Checkpointer
+
+    opt = train_lib.get_optimizer(args.optimizer, args.learning_rate)
+    example = np.asarray(
+        graph.sample_node(args.batch_size, args.train_node_type)
+    )
+    state = model.init_state(jax.random.PRNGKey(args.seed), graph, example,
+                             opt)
+    ckpt = Checkpointer(args.model_dir)
+    if ckpt.latest_step() is not None:
+        state = ckpt.restore(state)
+    else:
+        log.warning("no checkpoint in %s; using fresh params",
+                    args.model_dir)
+    return state
+
+
+def run_evaluate(model, graph, args, mesh):
+    state = _restore_state(model, graph, args, mesh)
+    if args.id_file:
+        ids = np.concatenate([
+            np.loadtxt(f, dtype=np.int64).reshape(-1)
+            for f in args.id_file.split(",")
+        ])
+    else:
+        ids = np.arange(args.max_id + 1, dtype=np.int64)
+    batch = args.batch_size
+    # Wrap-pad to a full batch multiple so every jitted shape is static
+    # (the reference streams exact ragged batches; with |ids| >> batch the
+    # duplicated rows are a negligible fraction of the metric counts).
+    # np.resize cycles ids, so this works even when len(ids) < pad.
+    pad = (-len(ids)) % batch
+    padded = np.resize(ids, len(ids) + pad) if pad else ids
+
+    def batches():
+        for i in range(0, len(padded), batch):
+            yield padded[i : i + batch]
+
+    return train_lib.evaluate(model, graph, batches(), state, mesh=mesh)
+
+
+def run_save_embedding(model, graph, args, mesh):
+    state = _restore_state(model, graph, args, mesh)
+    emb = train_lib.save_embedding(
+        model, graph, args.max_id, state, batch_size=args.batch_size,
+        mesh=mesh,
+    )
+    os.makedirs(args.model_dir, exist_ok=True)
+    out = os.path.join(args.model_dir, "embedding.npy")
+    np.save(out, emb)
+    ids_out = os.path.join(args.model_dir, "id.txt")
+    np.savetxt(ids_out, np.arange(args.max_id + 1, dtype=np.int64), fmt="%d")
+    log.info("saved %s %s and %s", out, emb.shape, ids_out)
+    return out
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    # Orbax/absl emit per-save INFO spam once a root handler exists.
+    logging.getLogger("absl").setLevel(logging.WARNING)
+    args = define_flags().parse_args(argv)
+    if args.coordinator_addr:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator_addr,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    graph, services = build_graph(args)
+    try:
+        mesh = make_mesh(args.num_devices)
+        model = build_model(args, graph)
+        if args.mode == "train":
+            run_train(model, graph, args, mesh)
+        elif args.mode == "evaluate":
+            run_evaluate(model, graph, args, mesh)
+        else:
+            run_save_embedding(model, graph, args, mesh)
+    finally:
+        for s in services:
+            s.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,11 +78,20 @@ def train(
     prefetch_threads: int = 2,
     state: Optional[dict] = None,
     log_fn=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    profile_dir: Optional[str] = None,
+    profile_steps: tuple = (10, 20),
 ):
     """Train and return (state, history).
 
     source_fn(step) -> int64 root-node batch (fixed size, divisible by the
     mesh size). All sampling runs in the prefetch workers.
+
+    checkpoint_dir enables MonitoredTrainingSession-style periodic save +
+    resume-from-latest (reference run_loop.py:132-138); profile_dir captures
+    a JAX profiler trace over profile_steps (the reference's ProfilerHook,
+    run_loop.py:124-126).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -93,6 +102,23 @@ def train(
         )
     rep = replicated_sharding(mesh)
     state = jax.device_put(state, rep)
+
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir:
+        from euler_tpu.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            state = ckpt.restore(state, latest)
+            state = jax.device_put(state, rep)
+            start_step = latest
+            (log_fn or log.info)(
+                f"resumed from {checkpoint_dir} at step {latest}"
+            )
+        if checkpoint_every <= 0:
+            checkpoint_every = max(num_steps // 10, 1)
     step_fn = jax.jit(
         model.make_train_step(opt),
         in_shardings=(rep, batch_sharding(mesh)),
@@ -111,7 +137,7 @@ def train(
     # (JAX dispatch is async; only materialize at the log boundary).
     window_metrics = []
     last_loss = None
-    steps_done = 0
+    steps_done = start_step
 
     def flush():
         nonlocal window_metrics, t0
@@ -130,17 +156,38 @@ def train(
         window_metrics = []
         t0 = time.time()
 
+    profiling = False
     for batch in prefetch(
-        make_batch, num_steps, prefetch_depth, prefetch_threads
+        make_batch,
+        num_steps - start_step,
+        prefetch_depth,
+        prefetch_threads,
+        start=start_step,
     ):
+        if profile_dir and steps_done - start_step == profile_steps[0]:
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
         batch = shard_batch(batch, mesh)
         state, last_loss, metric = step_fn(state, batch)
         window_metrics.append(metric)
         steps_done += 1
+        if profiling and steps_done - start_step >= profile_steps[1]:
+            jax.block_until_ready(last_loss)
+            jax.profiler.stop_trace()
+            profiling = False
+            (log_fn or log.info)(f"profiler trace written to {profile_dir}")
         if len(window_metrics) == log_every:
             flush()
+        if ckpt and steps_done % checkpoint_every == 0:
+            ckpt.save(steps_done, state)
     if window_metrics:  # final partial window
         flush()
+    if profiling:
+        jax.profiler.stop_trace()
+    if ckpt:
+        if steps_done % checkpoint_every != 0:
+            ckpt.save(steps_done, state, force=True)
+        ckpt.wait()
     return state, history
 
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Distributed training launcher on one machine: N graph-service shards +
+# shared-mode training (each training process serves its shard and connects
+# a remote client over the flat-file registry).
+#
+# Reference equivalent: tf_euler/scripts/dist_tf_euler.sh (PS + worker
+# processes + ZK-registered graph shards) — here there are no parameter
+# servers (gradients all-reduce inside the jitted step) and no ZooKeeper
+# (flat-file registry).
+#
+# Usage: examples/dist_train.sh DATA_DIR NUM_SHARDS [extra run_loop flags...]
+set -euo pipefail
+
+DATA_DIR=${1:?usage: dist_train.sh DATA_DIR NUM_SHARDS [flags...]}
+NUM_SHARDS=${2:?usage: dist_train.sh DATA_DIR NUM_SHARDS [flags...]}
+shift 2
+
+REGISTRY=$(mktemp -d /tmp/euler_registry.XXXXXX)
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$ROOT${PYTHONPATH:+:$PYTHONPATH}"
+
+pids=()
+cleanup() { kill "${pids[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+# Shards 1..N-1 as standalone service processes; shard 0 is served by the
+# training process itself (--graph_mode=shared).
+for ((s = 1; s < NUM_SHARDS; s++)); do
+  python -m euler_tpu.graph.service \
+    --data_dir "$DATA_DIR" --shard_idx "$s" --shard_num "$NUM_SHARDS" \
+    --registry "$REGISTRY" &
+  pids+=($!)
+done
+
+python -m euler_tpu \
+  --data_dir "$DATA_DIR" --graph_mode shared --registry "$REGISTRY" \
+  --num_processes "$NUM_SHARDS" --process_id 0 "$@"
