@@ -77,6 +77,7 @@ def main() -> None:
         feature_idx=1,
         feature_dim=FEATURE_DIM,
         max_id=NUM_NODES - 1,
+        device_features=True,
     )
 
     mesh = make_mesh()

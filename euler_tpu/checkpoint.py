@@ -42,13 +42,21 @@ class Checkpointer:
     def save(self, step: int, state: Any, force: bool = False) -> None:
         import orbax.checkpoint as ocp
 
+        # 'consts' holds device-resident graph tables (features/labels) —
+        # immutable inputs reconstructible from the graph, per the module
+        # invariant that graph data is never checkpointed. Excluding them
+        # also keeps checkpoints interchangeable across device_features
+        # on/off.
+        if isinstance(state, dict) and "consts" in state:
+            state = {k: v for k, v in state.items() if k != "consts"}
         self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure of state_like (an initialized state
-        pytree — shapes/dtypes/shardings are taken from it)."""
+        pytree — shapes/dtypes/shardings are taken from it). A 'consts'
+        entry in state_like is carried over as-is, not read from disk."""
         import jax
         import orbax.checkpoint as ocp
 
@@ -56,6 +64,12 @@ class Checkpointer:
             step = self._mngr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.dir}")
+        consts = None
+        if isinstance(state_like, dict) and "consts" in state_like:
+            consts = state_like["consts"]
+            state_like = {
+                k: v for k, v in state_like.items() if k != "consts"
+            }
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(
                 np.shape(x),
@@ -64,9 +78,13 @@ class Checkpointer:
             ),
             state_like,
         )
-        return self._mngr.restore(
+        restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(abstract)
         )
+        if consts is not None:
+            restored = dict(restored)
+            restored["consts"] = consts
+        return restored
 
     def wait(self) -> None:
         """Block until async saves complete (call before process exit)."""

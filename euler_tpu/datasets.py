@@ -56,6 +56,10 @@ def build_synthetic(
         for name in os.listdir(out_dir):
             if name.endswith(".dat") or name in ("done", "meta.json"):
                 os.unlink(os.path.join(out_dir, name))
+    elif any(n.endswith(".dat") for n in os.listdir(out_dir)):
+        # .dat partitions but no synthetic marker: this is a real converted
+        # dataset — never overwrite it, use it as-is.
+        return out_dir
     from euler_tpu.graph.convert import pack_block
 
     rng = np.random.default_rng(seed)

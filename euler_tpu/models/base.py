@@ -94,16 +94,35 @@ def shared_negs_decoder(emb, emb_pos, emb_negs, xent_loss: bool):
     return loss, mrr
 
 
+def gather_consts(feats: dict, consts: dict) -> dict:
+    """Materialize device-resident features for one node set: replace the
+    host-side 'gids' indices with a gather from the HBM-resident table."""
+    if consts and "gids" in feats and "features" in consts:
+        feats = dict(feats)
+        feats["dense"] = consts["features"][feats["gids"]]
+    return feats
+
+
 class Model:
     """Host-side model driver: owns config, builds the flax module, and
     implements the sampling phase. Subclasses define:
       module: nn.Module with __call__(batch) -> ModelOutput
       sample(graph, inputs) -> batch dict (numpy arrays, fixed shapes)
     and optionally sample_embed/embed for inference. Models with extra
-    device state (embedding stores) override init_state/make_train_step."""
+    device state (embedding stores) override init_state/make_train_step.
+
+    device_features=True switches dense feature/label delivery from
+    host-gather-and-transfer to device-resident tables: init_state uploads
+    the full feature (and label) table to HBM once (state['consts'],
+    replicated, aliased across steps via donation), sample() ships only
+    int32 node ids, and the module gathers rows on device. This is the
+    TPU-native replacement for the reference's PS-side embedding gathers
+    (tf_euler/python/utils/embedding.py) and cuts per-step host->device
+    traffic by ~feature_dim x."""
 
     metric_name = "loss"
     batch_size_ratio = 1  # reference Model.batch_size_ratio
+    device_features = False
 
     def __init__(self):
         self.module: nn.Module = None
@@ -127,9 +146,16 @@ class Model:
         if getattr(self, "use_id", False):
             feats["ids"] = np.clip(ids, 0, self.max_id + 1).astype(np.int32)
         if getattr(self, "feature_idx", -1) >= 0:
-            feats["dense"] = graph.get_dense_feature(
-                ids, [self.feature_idx], [self.feature_dim]
-            )
+            if self.device_features:
+                feats["gids"] = (
+                    feats["ids"]
+                    if "ids" in feats
+                    else np.clip(ids, 0, self.max_id + 1).astype(np.int32)
+                )
+            else:
+                feats["dense"] = graph.get_dense_feature(
+                    ids, [self.feature_idx], [self.feature_dim]
+                )
         sparse_idx = getattr(self, "sparse_feature_idx", [])
         if sparse_idx:
             feats["sparse"] = ops.get_sparse_feature(
@@ -144,19 +170,58 @@ class Model:
         return feats
 
     # ---- device state & steps ----
+    def build_consts(self, graph) -> dict:
+        """Device-resident lookup tables (uploaded once at init). Row
+        max_id+1 is the default/padding node; the engine returns zeros for
+        it, matching the host-gather path's default fill."""
+        if not self.device_features:
+            return {}
+        n = self.max_id + 2
+        ids = np.arange(n, dtype=np.int64)
+        consts = {
+            "features": jnp.asarray(
+                graph.get_dense_feature(
+                    ids, [self.feature_idx], [self.feature_dim]
+                )
+            )
+        }
+        if getattr(self, "label_idx", -1) >= 0:
+            consts["labels"] = jnp.asarray(
+                graph.get_dense_feature(
+                    ids, [self.label_idx], [self.label_dim]
+                )
+            )
+        return consts
+
+    def _apply(self, params, batch, consts, **kw):
+        if consts:
+            return self.module.apply({"params": params}, batch, consts, **kw)
+        return self.module.apply({"params": params}, batch, **kw)
+
     def init_state(self, rng, graph, example_inputs, optimizer) -> dict:
         batch = self.sample(graph, example_inputs)
-        variables = self.module.init(rng, batch)
+        consts = self.build_consts(graph)
+        if consts:
+            variables = self.module.init(rng, batch, consts)
+        else:
+            variables = self.module.init(rng, batch)
         params = variables["params"]
-        return {"params": params, "opt_state": optimizer.init(params)}
+        state = {"params": params, "opt_state": optimizer.init(params)}
+        if consts:
+            state["consts"] = consts
+        return state
 
     def make_train_step(self, optimizer):
         """Pure (state, batch) -> (state, loss, metric); jitted by the
-        trainer with params replicated and batch sharded over 'data'."""
+        trainer with params replicated and batch sharded over 'data'. The
+        (donated) consts tables pass through unchanged, so XLA aliases
+        their buffers — zero copies per step."""
 
         def train_step(state, batch):
+            consts = state.get("consts")
+
             def loss_fn(p):
-                out = self.module.apply({"params": p}, batch)
+                out = self._apply(p, batch, consts)
                 return out.loss, out
 
             (loss, out), grads = jax.value_and_grad(
@@ -166,26 +231,26 @@ class Model:
                 grads, state["opt_state"], state["params"]
             )
             params = optax.apply_updates(state["params"], updates)
-            return (
-                {"params": params, "opt_state": opt_state},
-                loss,
-                out.metric,
-            )
+            new_state = {"params": params, "opt_state": opt_state}
+            if consts:
+                new_state["consts"] = consts
+            return new_state, loss, out.metric
 
         return train_step
 
     def make_eval_step(self):
         def eval_step(state, batch):
-            out = self.module.apply({"params": state["params"]}, batch)
+            out = self._apply(state["params"], batch, state.get("consts"))
             return out.loss, out.metric
 
         return eval_step
 
     def make_embed_step(self):
         def embed_step(state, batch):
-            return self.module.apply(
-                {"params": state["params"]},
+            return self._apply(
+                state["params"],
                 batch,
+                state.get("consts"),
                 method=self.module.embed,
             )
 

@@ -48,14 +48,26 @@ class _SupervisedSageModule(nn.Module):
         )
         self.predict = nn.Dense(self.num_classes)
 
-    def embed(self, batch):
-        hidden = [self.node_encoder(f) for f in batch["hops"]]
+    def embed(self, batch, consts=None):
+        hidden = [
+            self.node_encoder(base.gather_consts(f, consts))
+            for f in batch["hops"]
+        ]
         return self.encoder(hidden)
 
-    def __call__(self, batch):
-        embedding = self.embed(batch)
+    def __call__(self, batch, consts=None):
+        embedding = self.embed(batch, consts)
         logits = self.predict(embedding)
-        labels = batch["labels"]
+        if "labels" in batch:
+            labels = batch["labels"]
+        else:  # device-resident label table, rows indexed by the roots
+            if not consts:
+                raise ValueError(
+                    "batch has no 'labels' and no consts tables were "
+                    "passed: a device_features=True batch must be applied "
+                    "with state['consts'] (from Model.init_state)"
+                )
+            labels = consts["labels"][batch["hops"][0]["gids"]]
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -92,8 +104,10 @@ class SupervisedGraphSage(base.Model):
         sparse_max_len: int = 16,
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
+        device_features: bool = False,
     ):
         super().__init__()
+        self.device_features = device_features and feature_idx >= 0
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.metapath = [list(m) for m in metapath]
@@ -125,6 +139,8 @@ class SupervisedGraphSage(base.Model):
             inputs, self.metapath, self.fanouts, self.default_node
         )
         hops = [self.node_inputs(graph, ids) for ids in ids_per_hop]
+        if self.device_features:
+            return {"hops": hops}  # labels gathered on device from consts
         labels = graph.get_dense_feature(
             inputs, [self.label_idx], [self.label_dim]
         )
@@ -291,20 +307,21 @@ class _UnsupervisedSageModule(nn.Module):
             self.fanouts, self.dim, self.aggregator, self.concat
         )
 
-    def _encode(self, hops, context: bool):
+    def _encode(self, hops, context: bool, consts=None):
+        hops = [base.gather_consts(f, consts) for f in hops]
         if context:
             hidden = [self.context_node_encoder(f) for f in hops]
             return self.context_encoder(hidden)
         hidden = [self.node_encoder(f) for f in hops]
         return self.encoder(hidden)
 
-    def embed(self, batch):
-        return self._encode(batch["src_hops"], context=False)
+    def embed(self, batch, consts=None):
+        return self._encode(batch["src_hops"], False, consts)
 
-    def __call__(self, batch):
-        emb = self._encode(batch["src_hops"], context=False)
-        emb_pos = self._encode(batch["pos_hops"], context=True)
-        emb_negs = self._encode(batch["neg_hops"], context=True)
+    def __call__(self, batch, consts=None):
+        emb = self._encode(batch["src_hops"], False, consts)
+        emb_pos = self._encode(batch["pos_hops"], True, consts)
+        emb_negs = self._encode(batch["neg_hops"], True, consts)
         B = emb.shape[0]
         emb3 = emb.reshape(B, 1, -1)
         pos3 = emb_pos.reshape(B, 1, -1)
@@ -344,8 +361,10 @@ class GraphSage(base.Model):
         xent_loss: bool = False,
         use_id: bool = False,
         embedding_dim: int = 16,
+        device_features: bool = False,
     ):
         super().__init__()
+        self.device_features = device_features and feature_idx >= 0
         self.node_type = node_type
         self.edge_type = list(edge_type)
         self.max_id = max_id

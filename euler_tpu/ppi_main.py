@@ -6,7 +6,7 @@
 
 import sys
 
-from euler_tpu.run_loop import define_flags, main
+from euler_tpu.run_loop import main
 
 PPI_DEFAULTS = [
     "--max_id", "56944",

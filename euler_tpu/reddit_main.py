@@ -6,7 +6,7 @@ max_id 232965, 602-dim features, 41 softmax classes).
 
 import sys
 
-from euler_tpu.run_loop import define_flags, main
+from euler_tpu.run_loop import main
 
 REDDIT_DEFAULTS = [
     "--max_id", "232965",

@@ -91,6 +91,11 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         choices=["gcn", "mean", "meanpool", "maxpool", "attention"],
     )
     p.add_argument("--concat", type=_str2bool, default=True)
+    p.add_argument(
+        "--device_features", type=_str2bool, default=False,
+        help="keep the dense feature/label tables HBM-resident and gather "
+             "on device (graphsage models); ships only node ids per step",
+    )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
     p.add_argument("--store_init_maxval", type=float, default=0.05)
@@ -142,26 +147,47 @@ def build_graph(args):
                 registry=args.registry,
             )
         )
-        # Wait for every shard to register before connecting. Only count
-        # well-formed "<shard>#..." entries, and fail loudly on timeout —
-        # stale entries from a SIGKILLed run also surface here as a clear
-        # error instead of a confusing connect failure later.
+        # Wait for every shard to register AND accept connections before
+        # connecting. A liveness probe (TCP connect) filters out stale
+        # entries left by a SIGKILLed prior run with the same --registry —
+        # those would otherwise satisfy a pure count check and produce a
+        # confusing connect failure later.
+        import socket
         import time
+
+        # Probe results are cached per filename: entries are immutable
+        # rename-once files, and re-probing dead hosts every poll would
+        # burn the deadline on serial 1s connect timeouts. Only a dead
+        # verdict is cached — a not-yet-listening live shard gets retried.
+        dead: set = set()
+
+        def _alive(entry: str) -> bool:
+            # registry filename: "<shard>#<host>_<port>" (eg_service.cc)
+            if entry in dead:
+                return False
+            try:
+                host, port = entry.split("#", 1)[1].rsplit("_", 1)
+                with socket.create_connection((host, int(port)), 1.0):
+                    return True
+            except (OSError, ValueError):
+                dead.add(entry)
+                return False
 
         deadline = time.time() + 120.0
         while True:
-            entries = {
+            live = {
                 f.split("#", 1)[0]
                 for f in os.listdir(args.registry)
-                if "#" in f
+                if "#" in f and not f.endswith(".tmp") and _alive(f)
             }
-            if len(entries) >= args.num_processes:
+            if len(live) >= args.num_processes:
                 break
             if time.time() > deadline:
                 raise TimeoutError(
-                    f"only shards {sorted(entries)} registered in "
+                    f"only live shards {sorted(live)} in "
                     f"{args.registry} after 120s "
-                    f"(need {args.num_processes})"
+                    f"(need {args.num_processes}; stale entries from a "
+                    f"killed run are ignored — clear the registry dir)"
                 )
             time.sleep(0.1)
         graph = euler_tpu.Graph(mode="remote", registry=args.registry)
@@ -177,7 +203,7 @@ class SavedEmbedding(models.Model):
     def __init__(self, embedding: np.ndarray, label_idx, label_dim,
                  num_classes=None, sigmoid_loss=True):
         import flax.linen as nn
-        import jax.numpy as jnp
+        import jax
 
         super().__init__()
         self.embedding = embedding.astype(np.float32)
@@ -205,8 +231,6 @@ class SavedEmbedding(models.Model):
 
             def embed(self, batch):
                 return batch["emb"]
-
-        import jax
 
         self.module = _Module()
 
@@ -306,6 +330,7 @@ def build_model(args, graph):
             concat=args.concat,
             feature_idx=args.feature_idx,
             feature_dim=args.feature_dim,
+            device_features=args.device_features,
         )
     if name == "graphsage_supervised":
         return models.SupervisedGraphSage(
@@ -315,6 +340,7 @@ def build_model(args, graph):
             aggregator=args.aggregator,
             concat=args.concat,
             max_id=args.max_id,
+            device_features=args.device_features,
             **common_sup,
         )
     if name == "scalable_sage":
@@ -408,11 +434,14 @@ def _restore_state(model, graph, args, mesh):
     state = model.init_state(jax.random.PRNGKey(args.seed), graph, example,
                              opt)
     ckpt = Checkpointer(args.model_dir)
-    if ckpt.latest_step() is not None:
-        state = ckpt.restore(state)
-    else:
-        log.warning("no checkpoint in %s; using fresh params",
-                    args.model_dir)
+    try:
+        if ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+        else:
+            log.warning("no checkpoint in %s; using fresh params",
+                        args.model_dir)
+    finally:
+        ckpt.close()
     return state
 
 

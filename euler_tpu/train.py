@@ -183,11 +183,13 @@ def train(
     if window_metrics:  # final partial window
         flush()
     if profiling:
+        jax.block_until_ready(last_loss)
         jax.profiler.stop_trace()
+        (log_fn or log.info)(f"profiler trace written to {profile_dir}")
     if ckpt:
         if steps_done % checkpoint_every != 0:
             ckpt.save(steps_done, state, force=True)
-        ckpt.wait()
+        ckpt.close()
     return state, history
 
 
