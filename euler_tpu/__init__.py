@@ -9,8 +9,12 @@ training over a jax.sharding.Mesh instead of parameter servers.
 
 from euler_tpu.graph.graph import Graph
 from euler_tpu.graph.convert import convert, convert_dicts
+from euler_tpu.graph.native import stats, stats_reset
 from euler_tpu.graph.service import GraphService
 
 __version__ = "0.1.0"
 
-__all__ = ["Graph", "GraphService", "convert", "convert_dicts"]
+__all__ = [
+    "Graph", "GraphService", "convert", "convert_dicts", "stats",
+    "stats_reset",
+]

@@ -1,0 +1,330 @@
+"""Interactive graph console.
+
+Reference equivalent: tools/console/console.cc:35-77 — a linenoise REPL
+over the graph client with commands help / con / nf / ef / nb. Rebuilt on
+Python readline over the ctypes client (a justified hybrid: the reference
+console is pure data plumbing over the client API, SURVEY §2.1), with the
+same command surface plus sampling/walk extras:
+
+    con  "directory=/data/ppi"            connect (key=value config)
+    con  "mode=remote;registry=/reg"      connect to a sharded service
+    nf   dense  "1, 2, 3" "0, 1"          node features by type + slots
+    nf   sparse "1, 2" "0"
+    nf   binary "1" "0"
+    ef   dense  "1:2:0, 2:3:1" "0"        edge features (src:dst:type ids)
+    nb   "1, 2, 3" "0, 1"                 full weighted neighbors
+    sn   <count> [node_type]              sample nodes
+    se   <count> [edge_type]              sample edges
+    walk "1, 2" "0" <len> [p] [q]         random walks
+    help [command] / quit
+
+Usage:  python -m euler_tpu.console [--config "directory=..."]
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+import numpy as np
+
+COMMANDS = {
+    "help": ("Command help message", "help [command]", "help con"),
+    "con": (
+        "Connect to a graph (embedded or remote)",
+        "con <config>",
+        'con "directory=/data/ppi"  |  con "mode=remote;registry=/reg"',
+    ),
+    "nf": (
+        "Get features for nodes (dense slots take fid:dim)",
+        "nf <dense|sparse|binary> <nids> <fids>",
+        'nf dense "1, 2, 3" "0:50, 1:2"  |  nf sparse "1, 2" "0"',
+    ),
+    "ef": (
+        "Get features for edges (dense slots take fid:dim)",
+        "ef <dense|sparse|binary> <src:dst:type,...> <fids>",
+        'ef dense "1:2:0, 2:3:1" "0:4"',
+    ),
+    "nb": (
+        "Get full weighted neighbors for nodes",
+        "nb <nids> <etypes>",
+        'nb "1, 2, 3" "0, 1"',
+    ),
+    "sn": ("Sample nodes by weight", "sn <count> [node_type=-1]", "sn 5 0"),
+    "se": ("Sample edges by weight", "se <count> [edge_type=-1]", "se 5"),
+    "walk": (
+        "Random walks (node2vec p/q optional)",
+        "walk <nids> <etypes> <walk_len> [p] [q]",
+        'walk "1, 2" "0" 5 1.0 2.0',
+    ),
+    "stats": (
+        "Show native span-timer stats (add 'reset' to zero them)",
+        "stats [reset]",
+        "stats",
+    ),
+    "quit": ("Exit the console", "quit", "quit"),
+}
+
+
+def _ids(text: str) -> np.ndarray:
+    return np.array(
+        [int(x) for x in text.replace(",", " ").split()], dtype=np.int64
+    )
+
+
+def _edge_ids(text: str):
+    src, dst, et = [], [], []
+    for tok in text.replace(",", " ").split():
+        s, d, t = tok.split(":")
+        src.append(int(s))
+        dst.append(int(d))
+        et.append(int(t))
+    return (
+        np.array(src, dtype=np.int64),
+        np.array(dst, dtype=np.int64),
+        et,
+    )
+
+
+def _dense_slots(text: str):
+    """Parse 'fid:dim' tokens (dim defaults to 1)."""
+    fids, dims = [], []
+    for tok in text.replace(",", " ").split():
+        if ":" in tok:
+            f, d = tok.split(":")
+        else:
+            f, d = tok, "1"
+        fids.append(int(f))
+        dims.append(int(d))
+    return fids, dims
+
+
+def _split_ragged(values, counts):
+    rows, off = [], 0
+    for c in counts:
+        rows.append(values[off : off + int(c)])
+        off += int(c)
+    return rows
+
+
+def _help(args: list) -> None:
+    names = [args[0]] if args and args[0] in COMMANDS else sorted(COMMANDS)
+    for name in names:
+        desc, usage, example = COMMANDS[name]
+        print(f"{name:6s} {desc}\n       usage:   {usage}"
+              f"\n       example: {example}")
+
+
+class Console:
+    def __init__(self):
+        self.graph = None
+
+    def _need_graph(self) -> bool:
+        if self.graph is None:
+            print("not connected — run: con \"directory=...\"", file=sys.stderr)
+            return False
+        return True
+
+    def do_con(self, args: list) -> None:
+        import euler_tpu
+
+        if not args:
+            return _help(["con"])
+        conf = {}
+        for kv in args[0].split(";"):
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            try:  # numeric params (shard_idx, shard_num, ...) arrive typed
+                conf[k] = int(v)
+            except ValueError:
+                conf[k] = v
+        mode = conf.pop("mode", "local")
+        self.graph = euler_tpu.Graph(mode=mode, **conf)
+        print(
+            f"connected: {self.graph.num_nodes} nodes, "
+            f"{self.graph.num_edges} edges, "
+            f"{self.graph.num_shards} shard(s)"
+        )
+
+    def do_nf(self, args: list) -> None:
+        if len(args) != 3:
+            return _help(["nf"])
+        if not self._need_graph():
+            return
+        kind, nids = args[0], _ids(args[1])
+        if kind == "dense":
+            fids, dims = _dense_slots(args[2])
+            vals = self.graph.get_dense_feature(nids, fids, dims)
+            for i, nid in enumerate(nids):
+                print(f"node {nid}: {vals[i].tolist()}")
+        elif kind == "sparse":
+            fids = [int(x) for x in _ids(args[2])]
+            slots = self.graph.get_sparse_feature(nids, fids)
+            for f, (values, counts) in zip(fids, slots):
+                for nid, row in zip(nids, _split_ragged(values, counts)):
+                    print(f"node {nid} slot {f}: {row.tolist()}")
+        elif kind == "binary":
+            fids = [int(x) for x in _ids(args[2])]
+            slots = self.graph.get_binary_feature(nids, fids)
+            for f, rows in zip(fids, slots):
+                for nid, row in zip(nids, rows):
+                    print(f"node {nid} slot {f}: {row!r}")
+        else:
+            _help(["nf"])
+
+    def do_ef(self, args: list) -> None:
+        if len(args) != 3:
+            return _help(["ef"])
+        if not self._need_graph():
+            return
+        kind = args[0]
+        src, dst, types = _edge_ids(args[1])
+        eids = list(zip(src.tolist(), dst.tolist(), types))
+        if kind == "dense":
+            fids, dims = _dense_slots(args[2])
+            vals = self.graph.get_edge_dense_feature(
+                src, dst, types, fids, dims
+            )
+            for i, eid in enumerate(eids):
+                print(f"edge {eid}: {vals[i].tolist()}")
+        elif kind == "sparse":
+            fids = [int(x) for x in _ids(args[2])]
+            slots = self.graph.get_edge_sparse_feature(src, dst, types, fids)
+            for f, (values, counts) in zip(fids, slots):
+                for eid, row in zip(eids, _split_ragged(values, counts)):
+                    print(f"edge {eid} slot {f}: {row.tolist()}")
+        elif kind == "binary":
+            fids = [int(x) for x in _ids(args[2])]
+            slots = self.graph.get_edge_binary_feature(src, dst, types, fids)
+            for f, rows in zip(fids, slots):
+                for eid, row in zip(eids, rows):
+                    print(f"edge {eid} slot {f}: {row!r}")
+        else:
+            _help(["ef"])
+
+    def do_nb(self, args: list) -> None:
+        if len(args) != 2:
+            return _help(["nb"])
+        if not self._need_graph():
+            return
+        nids = _ids(args[0])
+        etypes = [int(x) for x in _ids(args[1])]
+        nbr, w, t, counts = self.graph.get_full_neighbor(nids, etypes)
+        off = 0
+        for nid, c in zip(nids, counts):
+            row = ", ".join(
+                f"{int(nbr[j])}({w[j]:.3g},t{int(t[j])})"
+                for j in range(off, off + int(c))
+            )
+            off += int(c)
+            print(f"node {nid}: [{row}]")
+
+    def do_sn(self, args: list) -> None:
+        if not args:
+            return _help(["sn"])
+        if not self._need_graph():
+            return
+        t = int(args[1]) if len(args) > 1 else -1
+        print(self.graph.sample_node(int(args[0]), t).tolist())
+
+    def do_se(self, args: list) -> None:
+        if not args:
+            return _help(["se"])
+        if not self._need_graph():
+            return
+        t = int(args[1]) if len(args) > 1 else -1
+        src, dst, types = self.graph.sample_edge(int(args[0]), t)
+        print([
+            (int(s), int(d), int(et))
+            for s, d, et in zip(src, dst, types)
+        ])
+
+    def do_walk(self, args: list) -> None:
+        if len(args) < 3:
+            return _help(["walk"])
+        if not self._need_graph():
+            return
+        nids = _ids(args[0])
+        etypes = [int(x) for x in _ids(args[1])]
+        p = float(args[3]) if len(args) > 3 else 1.0
+        q = float(args[4]) if len(args) > 4 else 1.0
+        walks = self.graph.random_walk(nids, etypes, int(args[2]), p=p, q=q)
+        for row in walks:
+            print(" -> ".join(str(int(x)) for x in row))
+
+    def do_stats(self, args: list) -> None:
+        from euler_tpu.graph.native import stats, stats_reset
+
+        if args and args[0] == "reset":
+            stats_reset()
+            print("stats reset")
+            return
+        snap = stats()
+        if not snap:
+            print("no ops recorded")
+            return
+        print(f"{'op':16s} {'count':>10s} {'total_ms':>10s} "
+              f"{'avg_us':>10s} {'max_us':>10s}")
+        for name, s in sorted(snap.items()):
+            print(f"{name:16s} {s['count']:10d} {s['total_ms']:10.2f} "
+                  f"{s['avg_us']:10.2f} {s['max_us']:10.2f}")
+
+    def execute(self, line: str) -> bool:
+        """Run one command line; returns False on quit."""
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:
+            print(f"parse error: {e}", file=sys.stderr)
+            return True
+        if not parts:
+            return True
+        cmd, args = parts[0], parts[1:]
+        if cmd in ("quit", "exit"):
+            return False
+        if cmd == "help":
+            _help(args)
+            return True
+        handler = getattr(self, f"do_{cmd}", None)
+        if handler is None:
+            print(f"invalid command: {cmd}", file=sys.stderr)
+            _help([])
+            return True
+        try:
+            handler(args)
+        except Exception as e:  # keep the REPL alive on bad input
+            print(f"error: {e}", file=sys.stderr)
+        return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="euler_tpu.console")
+    ap.add_argument("--config", default="",
+                    help='connect on startup, e.g. "directory=/data/ppi"')
+    args = ap.parse_args(argv)
+    try:
+        import readline  # noqa: F401  (history + line editing)
+    except ImportError:
+        pass
+    console = Console()
+    if args.config:
+        try:
+            console.do_con([args.config])
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+    while True:
+        try:
+            line = input("euler> ")
+        except EOFError:
+            break
+        except KeyboardInterrupt:
+            print()
+            continue
+        if not console.execute(line):
+            break
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,7 @@
 #include <vector>
 
 #include "eg_engine.h"
+#include "eg_stats.h"
 #include "eg_remote.h"
 #include "eg_service.h"
 
@@ -112,20 +113,24 @@ void eg_type_weight_sums(void* h, int kind, float* out) {
 
 // ---- sampling ----
 void eg_sample_node(void* h, int count, int32_t type, uint64_t* out) {
+  eg::SpanTimer span(eg::kStatSampleNode);
   API(h)->SampleNode(count, type, out);
 }
 
 void eg_sample_edge(void* h, int count, int32_t type, uint64_t* out_src,
                     uint64_t* out_dst, int32_t* out_type) {
+  eg::SpanTimer span(eg::kStatSampleEdge);
   API(h)->SampleEdge(count, type, out_src, out_dst, out_type);
 }
 
 void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
                              uint64_t* out) {
+  eg::SpanTimer span(eg::kStatSampleNode);
   API(h)->SampleNodeWithSrc(src, n, count, out);
 }
 
 void eg_get_node_type(void* h, const uint64_t* ids, int n, int32_t* out) {
+  eg::SpanTimer span(eg::kStatNodeType);
   API(h)->GetNodeType(ids, n, out);
 }
 
@@ -133,6 +138,7 @@ void eg_sample_neighbor(void* h, const uint64_t* ids, int n,
                         const int32_t* etypes, int net, int count,
                         uint64_t default_id, uint64_t* out_ids, float* out_w,
                         int32_t* out_t) {
+  eg::SpanTimer span(eg::kStatSampleNeighbor);
   API(h)->SampleNeighbor(ids, n, etypes, net, count,
                                           default_id, out_ids, out_w, out_t);
 }
@@ -144,6 +150,7 @@ void eg_sample_fanout(void* h, const uint64_t* ids, int n,
                       const int32_t* etypes_flat, const int32_t* etype_counts,
                       const int32_t* counts, int nhops, uint64_t default_id,
                       uint64_t** out_ids, float** out_w, int32_t** out_t) {
+  eg::SpanTimer span(eg::kStatSampleFanout);
   API(h)->SampleFanout(ids, n, etypes_flat, etype_counts,
                                         counts, nhops, default_id, out_ids,
                                         out_w, out_t);
@@ -151,6 +158,7 @@ void eg_sample_fanout(void* h, const uint64_t* ids, int n,
 
 void* eg_get_full_neighbor(void* h, const uint64_t* ids, int n,
                            const int32_t* etypes, int net, int sorted) {
+  eg::SpanTimer span(eg::kStatFullNeighbor);
   return API(h)->GetFullNeighbor(ids, n, etypes, net,
                                                   sorted != 0);
 }
@@ -159,6 +167,7 @@ void eg_get_top_k_neighbor(void* h, const uint64_t* ids, int n,
                            const int32_t* etypes, int net, int k,
                            uint64_t default_id, uint64_t* out_ids,
                            float* out_w, int32_t* out_t) {
+  eg::SpanTimer span(eg::kStatTopKNeighbor);
   API(h)->GetTopKNeighbor(ids, n, etypes, net, k, default_id,
                                            out_ids, out_w, out_t);
 }
@@ -168,6 +177,7 @@ void eg_random_walk(void* h, const uint64_t* ids, int n,
                     const int32_t* etypes_flat, const int32_t* etype_counts,
                     int walk_len, float p, float q, uint64_t default_id,
                     uint64_t* out) {
+  eg::SpanTimer span(eg::kStatRandomWalk);
   API(h)->RandomWalk(ids, n, etypes_flat, etype_counts,
                                       walk_len, p, q, default_id, out);
 }
@@ -176,6 +186,7 @@ void eg_random_walk(void* h, const uint64_t* ids, int n,
 void eg_get_dense_feature(void* h, const uint64_t* ids, int n,
                           const int32_t* fids, const int32_t* dims, int nf,
                           float* out) {
+  eg::SpanTimer span(eg::kStatDenseFeature);
   API(h)->GetDenseFeature(ids, n, fids, dims, nf, out);
 }
 
@@ -183,30 +194,35 @@ void eg_get_edge_dense_feature(void* h, const uint64_t* src,
                                const uint64_t* dst, const int32_t* types,
                                int n, const int32_t* fids,
                                const int32_t* dims, int nf, float* out) {
+  eg::SpanTimer span(eg::kStatDenseFeature);
   API(h)->GetEdgeDenseFeature(src, dst, types, n, fids, dims,
                                                nf, out);
 }
 
 void* eg_get_sparse_feature(void* h, const uint64_t* ids, int n,
                             const int32_t* fids, int nf) {
+  eg::SpanTimer span(eg::kStatSparseFeature);
   return API(h)->GetSparseFeature(ids, n, fids, nf);
 }
 
 void* eg_get_edge_sparse_feature(void* h, const uint64_t* src,
                                  const uint64_t* dst, const int32_t* types,
                                  int n, const int32_t* fids, int nf) {
+  eg::SpanTimer span(eg::kStatSparseFeature);
   return API(h)->GetEdgeSparseFeature(src, dst, types, n,
                                                        fids, nf);
 }
 
 void* eg_get_binary_feature(void* h, const uint64_t* ids, int n,
                             const int32_t* fids, int nf) {
+  eg::SpanTimer span(eg::kStatBinaryFeature);
   return API(h)->GetBinaryFeature(ids, n, fids, nf);
 }
 
 void* eg_get_edge_binary_feature(void* h, const uint64_t* src,
                                  const uint64_t* dst, const int32_t* types,
                                  int n, const int32_t* fids, int nf) {
+  eg::SpanTimer span(eg::kStatBinaryFeature);
   return API(h)->GetEdgeBinaryFeature(src, dst, types, n,
                                                        fids, nf);
 }
@@ -259,5 +275,21 @@ void eg_result_copy(void* r, int kind, int slot, void* out) {
 }
 
 void eg_result_free(void* r) { delete static_cast<EGResult*>(r); }
+
+
+// ---- stats (span-timer subsystem, eg_stats.h) ----
+int eg_stat_count() { return eg::kStatOpCount; }
+
+const char* eg_stat_name(int i) {
+  return (i >= 0 && i < eg::kStatOpCount) ? eg::kStatNames[i] : "";
+}
+
+// out arrays sized eg_stat_count().
+void eg_stats_snapshot(uint64_t* counts, uint64_t* total_ns,
+                       uint64_t* max_ns) {
+  eg::Stats::Global().Snapshot(counts, total_ns, max_ns);
+}
+
+void eg_stats_reset() { eg::Stats::Global().Reset(); }
 
 }  // extern "C"

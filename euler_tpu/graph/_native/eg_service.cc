@@ -9,6 +9,7 @@
 #include <cstdio>
 #include <fstream>
 
+#include "eg_stats.h"
 #include "eg_wire.h"
 
 namespace eg {
@@ -150,6 +151,7 @@ void Service::HandleConn(int fd) {
 }
 
 void Service::Dispatch(const std::string& req, std::string* reply) const {
+  eg::SpanTimer span(eg::kStatServiceRequest);
   WireReader r(req);
   uint8_t op = r.U8();
   WireWriter w;

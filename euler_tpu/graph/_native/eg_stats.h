@@ -1,0 +1,107 @@
+// Span-timer statistics for the sampling engine and service.
+//
+// The reference ships only a thread-local stopwatch used in one perf test
+// (reference euler/common/timmer.cc:24-33) and glog lines; SURVEY §5.1
+// calls for a real span timer in the TPU build's sampling service. This is
+// it: lock-free per-op accumulators (count / total ns / max ns) recorded
+// at the C-ABI choke point, so every query — embedded engine, remote
+// client round-trip, or service-side request — is measured with one
+// mechanism. Snapshots are racy-but-consistent-enough reads of relaxed
+// atomics; overhead per call is two clock reads + three relaxed RMWs.
+#ifndef EG_STATS_H_
+#define EG_STATS_H_
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+
+namespace eg {
+
+enum StatOp : int {
+  kStatSampleNode = 0,
+  kStatSampleEdge,
+  kStatSampleNeighbor,
+  kStatSampleFanout,
+  kStatFullNeighbor,
+  kStatTopKNeighbor,
+  kStatRandomWalk,
+  kStatDenseFeature,
+  kStatSparseFeature,
+  kStatBinaryFeature,
+  kStatNodeType,
+  kStatServiceRequest,  // one per served RPC (service side)
+  kStatOpCount,
+};
+
+// Fixed-order names; Python reads them at runtime via eg_stat_name(i).
+const char* const kStatNames[kStatOpCount] = {
+    "sample_node",    "sample_edge",   "sample_neighbor", "sample_fanout",
+    "full_neighbor",  "topk_neighbor", "random_walk",     "dense_feature",
+    "sparse_feature", "binary_feature", "node_type",      "service_request",
+};
+
+class Stats {
+ public:
+  static Stats& Global() {
+    static Stats s;
+    return s;
+  }
+
+  void Record(StatOp op, uint64_t ns) {
+    auto& c = cells_[op];
+    c.count.fetch_add(1, std::memory_order_relaxed);
+    c.total_ns.fetch_add(ns, std::memory_order_relaxed);
+    uint64_t prev = c.max_ns.load(std::memory_order_relaxed);
+    while (prev < ns &&
+           !c.max_ns.compare_exchange_weak(prev, ns,
+                                           std::memory_order_relaxed)) {
+    }
+  }
+
+  void Snapshot(uint64_t* counts, uint64_t* total_ns, uint64_t* max_ns) const {
+    for (int i = 0; i < kStatOpCount; ++i) {
+      counts[i] = cells_[i].count.load(std::memory_order_relaxed);
+      total_ns[i] = cells_[i].total_ns.load(std::memory_order_relaxed);
+      max_ns[i] = cells_[i].max_ns.load(std::memory_order_relaxed);
+    }
+  }
+
+  void Reset() {
+    for (auto& c : cells_) {
+      c.count.store(0, std::memory_order_relaxed);
+      c.total_ns.store(0, std::memory_order_relaxed);
+      c.max_ns.store(0, std::memory_order_relaxed);
+    }
+  }
+
+ private:
+  struct Cell {
+    std::atomic<uint64_t> count{0};
+    std::atomic<uint64_t> total_ns{0};
+    std::atomic<uint64_t> max_ns{0};
+  };
+  Cell cells_[kStatOpCount];
+};
+
+// RAII span: records wall time from construction to destruction.
+class SpanTimer {
+ public:
+  explicit SpanTimer(StatOp op)
+      : op_(op), start_(std::chrono::steady_clock::now()) {}
+  ~SpanTimer() {
+    auto ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  std::chrono::steady_clock::now() - start_)
+                  .count();
+    Stats::Global().Record(op_, static_cast<uint64_t>(ns));
+  }
+  SpanTimer(const SpanTimer&) = delete;
+  SpanTimer& operator=(const SpanTimer&) = delete;
+
+ private:
+  StatOp op_;
+  std::chrono::steady_clock::time_point start_;
+};
+
+}  // namespace eg
+
+#endif  // EG_STATS_H_

@@ -53,6 +53,10 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_load, c.c_int, [p, c.c_char_p, c.c_int, c.c_int])
     _sig(L.eg_load_files, c.c_int, [p, c.POINTER(c.c_char_p), c.c_int])
     _sig(L.eg_seed, None, [c.c_uint64])
+    _sig(L.eg_stat_count, c.c_int, [])
+    _sig(L.eg_stat_name, c.c_char_p, [c.c_int])
+    _sig(L.eg_stats_snapshot, None, [u64p, u64p, u64p])
+    _sig(L.eg_stats_reset, None, [])
     _sig(L.eg_remote_create, p, [c.c_char_p])
     _sig(L.eg_remote_shards, c.c_int, [p])
     _sig(L.eg_remote_partitions, c.c_int, [p])
@@ -125,3 +129,40 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_result_free, None, [p])
     _lib = L
     return L
+
+
+def stats() -> dict:
+    """Snapshot of the native span-timer accumulators (process-global:
+    embedded engine calls, remote client round-trips, and served shard
+    requests all record here — see _native/eg_stats.h). Returns
+    {op: {count, total_ms, avg_us, max_us}} for ops with count > 0."""
+    import numpy as np
+
+    L = lib()
+    n = L.eg_stat_count()
+    counts = np.zeros(n, dtype=np.uint64)
+    total = np.zeros(n, dtype=np.uint64)
+    mx = np.zeros(n, dtype=np.uint64)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    L.eg_stats_snapshot(
+        counts.ctypes.data_as(u64p),
+        total.ctypes.data_as(u64p),
+        mx.ctypes.data_as(u64p),
+    )
+    out = {}
+    for i in range(n):
+        if counts[i] == 0:
+            continue
+        name = L.eg_stat_name(i).decode()
+        out[name] = {
+            "count": int(counts[i]),
+            "total_ms": float(total[i]) / 1e6,
+            "avg_us": float(total[i]) / float(counts[i]) / 1e3,
+            "max_us": float(mx[i]) / 1e3,
+        }
+    return out
+
+
+def stats_reset() -> None:
+    """Zero the native span-timer accumulators."""
+    lib().eg_stats_reset()

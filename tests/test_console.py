@@ -1,0 +1,90 @@
+"""Console REPL tests (reference tools/console/console.cc command surface)
+driven through Console.execute on the fixture graph."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.console import Console
+
+
+@pytest.fixture()
+def console(fixture_dir, capsys):
+    c = Console()
+    assert c.execute(f'con "directory={fixture_dir}"')
+    out = capsys.readouterr().out
+    assert "connected:" in out
+    return c
+
+
+def test_help_lists_commands(capsys):
+    c = Console()
+    c.execute("help")
+    out = capsys.readouterr().out
+    for cmd in ("con", "nf", "ef", "nb", "sn", "walk"):
+        assert cmd in out
+
+
+def test_nf_dense(console, capsys):
+    console.execute('nf dense "10, 12" "0:2"')
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2
+    assert out[0].startswith("node 10:")
+
+
+def test_nf_sparse_and_binary(console, capsys):
+    console.execute('nf sparse "10, 12" "0"')
+    out = capsys.readouterr().out
+    assert "node 10 slot 0:" in out
+    console.execute('nf binary "10" "0"')
+    out = capsys.readouterr().out
+    assert "node 10 slot 0: b" in out
+
+
+def test_nb_lists_neighbors(console, capsys, graph):
+    console.execute('nb "10" "0, 1"')
+    out = capsys.readouterr().out
+    nbr, w, t, counts = graph.get_full_neighbor([10], [0, 1])
+    assert out.startswith("node 10: [")
+    for nid in nbr:
+        assert str(int(nid)) in out
+
+
+def test_sn_and_walk(console, capsys):
+    console.execute("sn 4 0")
+    ids = eval(capsys.readouterr().out.strip())
+    assert len(ids) == 4
+    console.execute('walk "10" "0, 1" 3')
+    out = capsys.readouterr().out.strip()
+    assert out.startswith("10 ->")
+    assert out.count("->") == 3
+
+
+def test_unknown_command_keeps_repl_alive(console, capsys):
+    assert console.execute("frobnicate")
+    assert "invalid command" in capsys.readouterr().err
+    assert not console.execute("quit")
+
+
+def test_error_does_not_kill_repl(console, capsys):
+    assert console.execute('nf dense "not_an_int" "0"')
+    assert "error:" in capsys.readouterr().err
+
+
+def test_stats_span_timers(console, capsys):
+    """The native span-timer subsystem records ops and resets."""
+    import euler_tpu
+
+    euler_tpu.stats_reset()
+    console.execute("sn 4 0")
+    console.execute('nb "10" "0"')
+    capsys.readouterr()
+    snap = euler_tpu.stats()
+    assert snap["sample_node"]["count"] >= 1
+    assert snap["full_neighbor"]["count"] >= 1
+    assert snap["sample_node"]["total_ms"] >= 0.0
+    console.execute("stats")
+    out = capsys.readouterr().out
+    assert "sample_node" in out and "avg_us" in out
+    console.execute("stats reset")
+    capsys.readouterr()
+    assert "sample_node" not in euler_tpu.stats()
