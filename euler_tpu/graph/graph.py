@@ -74,6 +74,18 @@ class Graph:
         self._lib = lib()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
+        for path in [directory or "", registry or ""] + list(files or []):
+            if path.startswith(("hdfs://", "s3://", "gs://")):
+                # The reference reads graph data straight off HDFS via
+                # libhdfs (reference euler/common/hdfs_file_io.cc:79-80);
+                # TPU hosts mount data as local/NFS paths instead, so
+                # remote filesystems are gated, not linked in.
+                raise NotImplementedError(
+                    f"remote filesystem paths are not supported ({path}); "
+                    "copy or mount the .dat partitions locally (e.g. "
+                    "gsutil/distcp to a local or NFS directory) and pass "
+                    "that directory"
+                )
         self.mode = mode
         if mode == "remote":
             if registry:
