@@ -103,6 +103,37 @@ def gather_consts(feats: dict, consts: dict) -> dict:
     return feats
 
 
+def lookup_labels(batch: dict, consts: dict, root_ids):
+    """Labels for a supervised batch: host-gathered if present, otherwise
+    a device gather from the consts label table at root_ids."""
+    if "labels" in batch:
+        return batch["labels"]
+    if not consts:
+        raise ValueError(
+            "batch has no 'labels' and no consts tables were passed: a "
+            "device_features=True batch must be applied with "
+            "state['consts'] (from Model.init_state)"
+        )
+    return consts["labels"][root_ids]
+
+
+def resolve_device_features(
+    device_features: bool, feature_idx: int, max_id: int
+) -> bool:
+    """Validate a model's device_features request. Silently off when the
+    model has no dense features; a hard error when max_id is unset, because
+    the table would have one row and every id would clip to it — silently
+    training all nodes on node 0's features."""
+    if not device_features or feature_idx < 0:
+        return False
+    if max_id < 0:
+        raise ValueError(
+            "device_features=True requires max_id >= 0 (the feature/label "
+            "tables are sized max_id+2)"
+        )
+    return True
+
+
 class Model:
     """Host-side model driver: owns config, builds the flax module, and
     implements the sampling phase. Subclasses define:
@@ -283,7 +314,9 @@ class ScalableStoreModel(Model):
             jnp.zeros((len(batch["neigh_ids"]), self.dim))
             for _ in range(self.num_layers - 1)
         ]
-        variables = self.module.init(rng, batch, store_reads)
+        consts = self.build_consts(graph) or None
+        # Scalable modules all take consts=None, so pass it positionally.
+        variables = self.module.init(rng, batch, store_reads, consts)
         params = variables["params"]
         n_store = self.max_id + 2
         k1 = jax.random.fold_in(rng, 1)
@@ -300,13 +333,16 @@ class ScalableStoreModel(Model):
             jnp.zeros((n_store, self.dim)) for _ in range(1, self.num_layers)
         ]
         store_opt = optax.adam(self.store_learning_rate)
-        return {
+        state = {
             "params": params,
             "opt_state": optimizer.init(params),
             "stores": stores,
             "grad_stores": grad_stores,
             "store_opt_state": store_opt.init(params),
         }
+        if consts:
+            state["consts"] = consts
+        return state
 
     def make_train_step(self, optimizer):
         store_opt = optax.adam(self.store_learning_rate)
@@ -316,6 +352,7 @@ class ScalableStoreModel(Model):
         def train_step(state, batch):
             node_ids = batch["node_ids"]
             neigh_ids = batch["neigh_ids"]
+            consts = state.get("consts")  # None when not device_features
             store_reads = [s[neigh_ids] for s in state["stores"]]
             stale = [gs[node_ids] for gs in state["grad_stores"]]
             grad_stores = [
@@ -328,6 +365,7 @@ class ScalableStoreModel(Model):
                     {"params": params},
                     batch,
                     reads,
+                    consts,
                     method=module.forward_train,
                 )
 
@@ -379,26 +417,31 @@ class ScalableStoreModel(Model):
                 "grad_stores": grad_stores,
                 "store_opt_state": store_opt_state,
             }
+            if consts:
+                new_state["consts"] = consts
             return new_state, loss, metric
 
         return train_step
 
-    def make_eval_step(self):
-        module = self.module
+    def _apply_with_stores(self, state, batch):
+        store_reads = [s[batch["neigh_ids"]] for s in state["stores"]]
+        return self.module.apply(
+            {"params": state["params"]},
+            batch,
+            store_reads,
+            state.get("consts"),
+        )
 
+    def make_eval_step(self):
         def eval_step(state, batch):
-            store_reads = [s[batch["neigh_ids"]] for s in state["stores"]]
-            out = module.apply({"params": state["params"]}, batch, store_reads)
+            out = self._apply_with_stores(state, batch)
             return out.loss, out.metric
 
         return eval_step
 
     def make_embed_step(self):
-        module = self.module
-
         def embed_step(state, batch):
-            store_reads = [s[batch["neigh_ids"]] for s in state["stores"]]
-            out = module.apply({"params": state["params"]}, batch, store_reads)
+            out = self._apply_with_stores(state, batch)
             return out.embedding
 
         return embed_step

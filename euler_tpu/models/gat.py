@@ -30,13 +30,19 @@ class _GATModule(nn.Module):
             out_dim=self.num_classes,
         )
 
-    def embed(self, batch):
-        return self.encoder(batch["seq"])
+    def embed(self, batch, consts=None):
+        if "seq" in batch:
+            return self.encoder(batch["seq"])
+        # device-resident features: gather [B, nb+1, fdim] from the table
+        return self.encoder(consts["features"][batch["seq_ids"]])
 
-    def __call__(self, batch):
+    def __call__(self, batch, consts=None):
         # The reference AttEncoder's out_dim IS num_classes (logits).
-        logits = self.embed(batch)
-        labels = batch["labels"]
+        logits = self.embed(batch, consts)
+        labels = base.lookup_labels(
+            batch, consts,
+            batch["seq_ids"][:, 0] if "seq_ids" in batch else None,
+        )
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -64,8 +70,12 @@ class GAT(base.Model):
         edge_type: int = 0,
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
+        device_features: bool = False,
     ):
         super().__init__()
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.feature_idx = feature_idx
@@ -89,6 +99,12 @@ class GAT(base.Model):
         nbrs, _, _ = graph.sample_neighbor(
             roots, self.edge_type, self.nb_num, default
         )
+        if self.device_features:
+            seq_ids = np.concatenate(
+                [roots.reshape(B, 1), nbrs.reshape(B, self.nb_num)], axis=1
+            )
+            seq_ids = np.clip(seq_ids, 0, self.max_id + 1).astype(np.int32)
+            return {"seq_ids": seq_ids}
         node_feats = graph.get_dense_feature(
             roots, [self.feature_idx], [self.feature_dim]
         ).reshape(B, 1, self.feature_dim)

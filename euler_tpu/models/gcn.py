@@ -58,14 +58,17 @@ class _SupervisedGCNModule(nn.Module):
         )
         self.predict = nn.Dense(self.num_classes)
 
-    def embed(self, batch):
-        hidden = [self.node_encoder(f) for f in batch["hops"]]
+    def embed(self, batch, consts=None):
+        hidden = [
+            self.node_encoder(base.gather_consts(f, consts))
+            for f in batch["hops"]
+        ]
         return self.encoder(hidden, batch["adjs"])
 
-    def __call__(self, batch):
-        embedding = self.embed(batch)
+    def __call__(self, batch, consts=None):
+        embedding = self.embed(batch, consts)
         logits = self.predict(embedding)
-        labels = batch["labels"]
+        labels = base.lookup_labels(batch, consts, batch["hops"][0].get("gids"))
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -103,8 +106,12 @@ class SupervisedGCN(base.Model):
         use_residual: bool = False,
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
+        device_features: bool = False,
     ):
         super().__init__()
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.metapath = [list(m) for m in metapath]
@@ -143,14 +150,12 @@ class SupervisedGCN(base.Model):
         hop_feats = [self.node_inputs(graph, roots)] + [
             self.node_inputs(graph, h.nodes) for h in hops
         ]
-        labels = graph.get_dense_feature(
-            roots, [self.label_idx], [self.label_dim]
-        )
-        return {
-            "hops": hop_feats,
-            "adjs": [h.adj for h in hops],
-            "labels": labels,
-        }
+        batch = {"hops": hop_feats, "adjs": [h.adj for h in hops]}
+        if not self.device_features:
+            batch["labels"] = graph.get_dense_feature(
+                roots, [self.label_idx], [self.label_dim]
+            )
+        return batch
 
 
 class _ScalableGCNModule(nn.Module):
@@ -188,9 +193,13 @@ class _ScalableGCNModule(nn.Module):
         ]
         self.predict = nn.Dense(self.num_classes)
 
-    def forward_train(self, batch, store_reads):
-        node_emb = self.node_encoder(batch["node_feats"])
-        neigh_emb = self.node_encoder(batch["neigh_feats"])
+    def forward_train(self, batch, store_reads, consts=None):
+        node_emb = self.node_encoder(
+            base.gather_consts(batch["node_feats"], consts)
+        )
+        neigh_emb = self.node_encoder(
+            base.gather_consts(batch["neigh_feats"], consts)
+        )
         adj = batch["adj"]
         node_embeddings = []
         for layer in range(self.num_layers):
@@ -202,7 +211,7 @@ class _ScalableGCNModule(nn.Module):
             if layer < self.num_layers - 1:
                 neigh_emb = store_reads[layer]
         logits = self.predict(node_emb)
-        labels = batch["labels"]
+        labels = base.lookup_labels(batch, consts, batch["node_ids"])
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -213,8 +222,8 @@ class _ScalableGCNModule(nn.Module):
             node_emb,
         )
 
-    def __call__(self, batch, store_reads):
-        loss, f1c, _, emb = self.forward_train(batch, store_reads)
+    def __call__(self, batch, store_reads, consts=None):
+        loss, f1c, _, emb = self.forward_train(batch, store_reads, consts)
         return base.ModelOutput(
             embedding=emb, loss=loss, metric_name="f1", metric=f1c
         )
@@ -248,8 +257,12 @@ class ScalableGCN(base.ScalableStoreModel):
         store_init_maxval: float = 0.05,
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
+        device_features: bool = False,
     ):
         super().__init__()
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.edge_type = list(edge_type)
@@ -292,15 +305,16 @@ class ScalableGCN(base.ScalableStoreModel):
             default_node=self.max_id + 1,
         )
         hop = hops[0]
-        labels = graph.get_dense_feature(
-            roots, [self.label_idx], [self.label_dim]
-        )
-        return {
+        batch = {
             "node_feats": self.node_inputs(graph, roots_out),
             "neigh_feats": self.node_inputs(graph, hop.nodes),
             "node_ids": np.clip(roots_out, 0, self.max_id + 1),
             "neigh_ids": np.clip(hop.nodes, 0, self.max_id + 1),
             "adj": hop.adj,
-            "labels": labels,
         }
+        if not self.device_features:
+            batch["labels"] = graph.get_dense_feature(
+                roots, [self.label_idx], [self.label_dim]
+            )
+        return batch
 

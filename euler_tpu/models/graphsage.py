@@ -58,16 +58,7 @@ class _SupervisedSageModule(nn.Module):
     def __call__(self, batch, consts=None):
         embedding = self.embed(batch, consts)
         logits = self.predict(embedding)
-        if "labels" in batch:
-            labels = batch["labels"]
-        else:  # device-resident label table, rows indexed by the roots
-            if not consts:
-                raise ValueError(
-                    "batch has no 'labels' and no consts tables were "
-                    "passed: a device_features=True batch must be applied "
-                    "with state['consts'] (from Model.init_state)"
-                )
-            labels = consts["labels"][batch["hops"][0]["gids"]]
+        labels = base.lookup_labels(batch, consts, batch["hops"][0].get("gids"))
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -107,7 +98,9 @@ class SupervisedGraphSage(base.Model):
         device_features: bool = False,
     ):
         super().__init__()
-        self.device_features = device_features and feature_idx >= 0
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.metapath = [list(m) for m in metapath]
@@ -177,12 +170,16 @@ class _ScalableSageModule(nn.Module):
         )
         self.predict = nn.Dense(self.num_classes)
 
-    def forward_train(self, batch, store_reads):
-        node_feat = self.node_encoder(batch["node_feats"])
-        neigh_feat = self.node_encoder(batch["neigh_feats"])
+    def forward_train(self, batch, store_reads, consts=None):
+        node_feat = self.node_encoder(
+            base.gather_consts(batch["node_feats"], consts)
+        )
+        neigh_feat = self.node_encoder(
+            base.gather_consts(batch["neigh_feats"], consts)
+        )
         emb, node_embeddings = self.encoder(node_feat, neigh_feat, store_reads)
         logits = self.predict(emb)
-        labels = batch["labels"]
+        labels = base.lookup_labels(batch, consts, batch["node_ids"])
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -193,8 +190,8 @@ class _ScalableSageModule(nn.Module):
             emb,
         )
 
-    def __call__(self, batch, store_reads):
-        loss, f1c, _, emb = self.forward_train(batch, store_reads)
+    def __call__(self, batch, store_reads, consts=None):
+        loss, f1c, _, emb = self.forward_train(batch, store_reads, consts)
         return base.ModelOutput(
             embedding=emb, loss=loss, metric_name="f1", metric=f1c
         )
@@ -227,8 +224,12 @@ class ScalableSage(base.ScalableStoreModel):
         store_init_maxval: float = 0.05,
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
+        device_features: bool = False,
     ):
         super().__init__()
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.edge_type = list(edge_type)
@@ -260,16 +261,17 @@ class ScalableSage(base.ScalableStoreModel):
             roots, [self.edge_type], [self.fanout], self.max_id + 1
         )
         neigh = ids_per_hop[1]
-        labels = graph.get_dense_feature(
-            roots, [self.label_idx], [self.label_dim]
-        )
-        return {
+        batch = {
             "node_feats": self.node_inputs(graph, roots),
             "neigh_feats": self.node_inputs(graph, neigh),
             "node_ids": np.clip(roots, 0, self.max_id + 1),
             "neigh_ids": np.clip(neigh, 0, self.max_id + 1),
-            "labels": labels,
         }
+        if not self.device_features:
+            batch["labels"] = graph.get_dense_feature(
+                roots, [self.label_idx], [self.label_dim]
+            )
+        return batch
 
 
 class _UnsupervisedSageModule(nn.Module):
@@ -364,7 +366,9 @@ class GraphSage(base.Model):
         device_features: bool = False,
     ):
         super().__init__()
-        self.device_features = device_features and feature_idx >= 0
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
         self.node_type = node_type
         self.edge_type = list(edge_type)
         self.max_id = max_id

@@ -94,7 +94,8 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument(
         "--device_features", type=_str2bool, default=False,
         help="keep the dense feature/label tables HBM-resident and gather "
-             "on device (graphsage models); ships only node ids per step",
+             "on device (graphsage/gcn/scalable/gat models); ships only "
+             "node ids per step",
     )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
@@ -300,6 +301,7 @@ def build_model(args, graph):
             aggregator=args.aggregator,
             max_id=args.max_id,
             use_residual=args.use_residual,
+            device_features=args.device_features,
             **common_sup,
         )
     if name == "scalable_gcn":
@@ -314,6 +316,7 @@ def build_model(args, graph):
             use_residual=args.use_residual,
             store_learning_rate=args.store_learning_rate,
             store_init_maxval=args.store_init_maxval,
+            device_features=args.device_features,
             **common_sup,
         )
     if name == "graphsage":
@@ -354,6 +357,7 @@ def build_model(args, graph):
             max_id=args.max_id,
             store_learning_rate=args.store_learning_rate,
             store_init_maxval=args.store_init_maxval,
+            device_features=args.device_features,
             **common_sup,
         )
     if name == "gat":
@@ -368,6 +372,7 @@ def build_model(args, graph):
             head_num=args.head_num,
             hidden_dim=args.dim,
             nb_num=5,
+            device_features=args.device_features,
         )
     if name == "lshne":
         return models.LsHNE(
