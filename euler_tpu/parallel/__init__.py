@@ -1,15 +1,21 @@
 from euler_tpu.parallel.mesh import (
     batch_sharding,
     make_mesh,
+    pad_tables_for_mesh,
     replicated_sharding,
     shard_batch,
+    state_sharding,
+    table_sharding,
 )
 from euler_tpu.parallel.prefetch import prefetch
 
 __all__ = [
     "batch_sharding",
     "make_mesh",
+    "pad_tables_for_mesh",
     "replicated_sharding",
     "shard_batch",
+    "state_sharding",
+    "table_sharding",
     "prefetch",
 ]

@@ -6,6 +6,13 @@ replica_device_setter): parameters are replicated across the mesh, each
 batch is sharded over the 'data' axis, and XLA inserts the gradient
 all-reduce over ICI inside the jitted train step. No parameter servers,
 no explicit gradient exchange code.
+
+A second optional 'model' axis row-shards the big per-node tables — the
+device-resident feature/label consts and the Scalable* historical-embedding
+stores. This is the TPU-native version of the reference's PS-sharded
+embedding tables (reference tf_euler/python/utils/embedding.py:22-67 'mod'
+partitioned scatter): total table HBM scales with the model axis, and XLA
+inserts the gather/scatter collectives inside the jitted step.
 """
 
 from __future__ import annotations
@@ -14,23 +21,95 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Top-level train-state keys holding [num_nodes, dim]-shaped tables that
+# row-shard over the 'model' axis.
+_TABLE_KEYS = ("consts", "stores", "grad_stores")
 
-def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
-    """1-D data-parallel mesh over the first num_devices devices."""
+
+def make_mesh(
+    num_devices: int | None = None,
+    devices=None,
+    model_parallel: int = 1,
+) -> Mesh:
+    """(data, model) mesh over the first num_devices devices.
+
+    model_parallel=1 (default) is pure data parallelism; k>1 dedicates a
+    k-wide 'model' axis for row-sharded tables.
+    """
     if devices is None:
         devices = jax.devices()
     if num_devices is not None:
         devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), ("data",))
+    devices = np.asarray(devices)
+    if len(devices) % model_parallel != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by "
+            f"model_parallel={model_parallel}"
+        )
+    return Mesh(
+        devices.reshape(-1, model_parallel), ("data", "model")
+    )
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard the leading (batch) dim over 'data'."""
+    """Shard the leading (batch) dim over 'data' (replicated over 'model')."""
     return NamedSharding(mesh, P("data"))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-shard a [rows, dim] table over the 'model' axis."""
+    return NamedSharding(mesh, P("model"))
+
+
+def _model_axis_size(mesh: Mesh) -> int:
+    return mesh.shape.get("model", 1)
+
+
+def _is_table(path, x) -> bool:
+    """True for leaves under a _TABLE_KEYS top-level state key — the
+    per-node tables that row-shard (and row-pad) over the model axis."""
+    key = path[0]
+    name = getattr(key, "key", getattr(key, "idx", None))
+    return name in _TABLE_KEYS and np.ndim(x) >= 1
+
+
+def state_sharding(mesh: Mesh, state):
+    """Sharding pytree for a train state: params/optimizer replicated,
+    per-node tables (consts, Scalable stores) row-sharded when the mesh has
+    a model axis. Matches state's tree structure, for jit in_/out_shardings
+    and device_put."""
+    rep = replicated_sharding(mesh)
+    if _model_axis_size(mesh) <= 1:
+        return jax.tree.map(lambda _: rep, state)
+    tab = table_sharding(mesh)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: tab if _is_table(path, x) else rep, state
+    )
+
+
+def pad_tables_for_mesh(state, mesh: Mesh):
+    """Pad table rows (dim 0) up to a multiple of the model axis so they
+    shard evenly. Extra rows are zero and never indexed (valid ids are
+    <= max_id+1 < original row count). Resuming a checkpoint requires the
+    same model_parallel setting, since store shapes include the padding."""
+    k = _model_axis_size(mesh)
+    if k <= 1:
+        return state
+
+    def pad(path, x):
+        if _is_table(path, x):
+            extra = (-x.shape[0]) % k
+            if extra:
+                return jax.numpy.pad(
+                    x, [(0, extra)] + [(0, 0)] * (np.ndim(x) - 1)
+                )
+        return x
+
+    return jax.tree_util.tree_map_with_path(pad, state)
 
 
 def shard_batch(batch, mesh: Mesh):

@@ -115,6 +115,10 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--num_devices", type=int, default=None,
                    help="devices in the data-parallel mesh (default: all)")
+    p.add_argument("--model_parallel", type=int, default=1,
+                   help="width of the 'model' mesh axis; >1 row-shards the "
+                        "device-resident tables (consts, Scalable stores) "
+                        "across it")
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
     p.add_argument("--profile_dir", default="")
@@ -438,6 +442,12 @@ def _restore_state(model, graph, args, mesh):
     )
     state = model.init_state(jax.random.PRNGKey(args.seed), graph, example,
                              opt)
+    # Model-parallel training saved tables row-padded to the model axis;
+    # the restore template must match those shapes (same --model_parallel
+    # as training).
+    from euler_tpu.parallel import pad_tables_for_mesh
+
+    state = pad_tables_for_mesh(state, mesh)
     ckpt = Checkpointer(args.model_dir)
     try:
         if ckpt.latest_step() is not None:
@@ -506,7 +516,7 @@ def main(argv=None) -> int:
         )
     graph, services = build_graph(args)
     try:
-        mesh = make_mesh(args.num_devices)
+        mesh = make_mesh(args.num_devices, model_parallel=args.model_parallel)
         model = build_model(args, graph)
         if args.mode == "train":
             run_train(model, graph, args, mesh)

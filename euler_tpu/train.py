@@ -21,9 +21,11 @@ from euler_tpu.nn import metrics as metrics_lib
 from euler_tpu.parallel import (
     batch_sharding,
     make_mesh,
+    pad_tables_for_mesh,
     prefetch,
     replicated_sharding,
     shard_batch,
+    state_sharding,
 )
 
 log = logging.getLogger("euler_tpu")
@@ -101,7 +103,11 @@ def train(
             jax.random.PRNGKey(seed), graph, source_fn(0), opt
         )
     rep = replicated_sharding(mesh)
-    state = jax.device_put(state, rep)
+    # Params/opt replicated; per-node tables row-sharded over the mesh's
+    # 'model' axis when present (pure DP: everything replicated).
+    state = pad_tables_for_mesh(state, mesh)
+    shardings = state_sharding(mesh, state)
+    state = jax.device_put(state, shardings)
 
     ckpt = None
     start_step = 0
@@ -112,7 +118,7 @@ def train(
         latest = ckpt.latest_step()
         if latest is not None:
             state = ckpt.restore(state, latest)
-            state = jax.device_put(state, rep)
+            state = jax.device_put(state, shardings)
             start_step = latest
             (log_fn or log.info)(
                 f"resumed from {checkpoint_dir} at step {latest}"
@@ -121,8 +127,8 @@ def train(
             checkpoint_every = max(num_steps // 10, 1)
     step_fn = jax.jit(
         model.make_train_step(opt),
-        in_shardings=(rep, batch_sharding(mesh)),
-        out_shardings=(rep, rep, rep),
+        in_shardings=(shardings, batch_sharding(mesh)),
+        out_shardings=(shardings, rep, rep),
         donate_argnums=(0,),
     )
 
@@ -206,9 +212,12 @@ def evaluate(
     if mesh is None:
         mesh = make_mesh()
     rep = replicated_sharding(mesh)
+    state = pad_tables_for_mesh(state, mesh)
+    shardings = state_sharding(mesh, state)
+    state = jax.device_put(state, shardings)
     eval_fn = jax.jit(
         model.make_eval_step(),
-        in_shardings=(rep, batch_sharding(mesh)),
+        in_shardings=(shardings, batch_sharding(mesh)),
         out_shardings=(rep, rep),
     )
     name = model.metric_name
@@ -236,10 +245,12 @@ def save_embedding(
     (reference run_loop.py:174-219 exports .npy + id file)."""
     if mesh is None:
         mesh = make_mesh()
-    rep = replicated_sharding(mesh)
+    state = pad_tables_for_mesh(state, mesh)
+    shardings = state_sharding(mesh, state)
+    state = jax.device_put(state, shardings)
     embed_fn = jax.jit(
         model.make_embed_step(),
-        in_shardings=(rep, batch_sharding(mesh)),
+        in_shardings=(shardings, batch_sharding(mesh)),
         out_shardings=batch_sharding(mesh),
     )
     chunks = []
