@@ -55,6 +55,9 @@ def build_synthetic_graph(cache_dir: str) -> str:
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from euler_tpu.parallel import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     import jax
 
     import euler_tpu

@@ -1,5 +1,6 @@
 from euler_tpu.parallel.mesh import (
     batch_sharding,
+    honor_jax_platforms_env,
     make_mesh,
     pad_tables_for_mesh,
     replicated_sharding,
@@ -11,6 +12,7 @@ from euler_tpu.parallel.prefetch import prefetch
 
 __all__ = [
     "batch_sharding",
+    "honor_jax_platforms_env",
     "make_mesh",
     "pad_tables_for_mesh",
     "replicated_sharding",

@@ -26,6 +26,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _TABLE_KEYS = ("consts", "stores", "grad_stores")
 
 
+def honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS effective even when a site hook pre-registered
+    another backend at interpreter start: the env var alone is ignored once
+    plugins are registered; only the config knob switches before backend
+    init. Call from CLI entry points before any jax.devices()."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def make_mesh(
     num_devices: int | None = None,
     devices=None,

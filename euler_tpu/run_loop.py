@@ -505,6 +505,9 @@ def main(argv=None) -> int:
     )
     # Orbax/absl emit per-save INFO spam once a root handler exists.
     logging.getLogger("absl").setLevel(logging.WARNING)
+    from euler_tpu.parallel import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     args = define_flags().parse_args(argv)
     if args.coordinator_addr:
         import jax
