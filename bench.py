@@ -101,14 +101,17 @@ def main() -> None:
     )
 
     def make_batch(step):
-        return model.sample(graph, graph.sample_node(BATCH, -1))
+        # transfer in the prefetch worker: H2D of batch k+1 overlaps
+        # device compute of step k
+        return shard_batch(
+            model.sample(graph, graph.sample_node(BATCH, -1)), mesh
+        )
 
     edges_per_step = BATCH * (FANOUTS[0] + FANOUTS[0] * FANOUTS[1])
 
     it = prefetch(make_batch, WARMUP + MEASURE, depth=3, num_threads=4)
     losses = []
     for i, batch in enumerate(it):
-        batch = shard_batch(batch, mesh)
         if i == WARMUP:
             jax.block_until_ready(state)
             t0 = time.time()

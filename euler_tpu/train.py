@@ -84,16 +84,25 @@ def train(
     checkpoint_every: int = 0,
     profile_dir: Optional[str] = None,
     profile_steps: tuple = (10, 20),
+    device_prefetch: bool = True,
 ):
     """Train and return (state, history).
 
     source_fn(step) -> int64 root-node batch (fixed size, divisible by the
     mesh size). All sampling runs in the prefetch workers.
 
+    device_prefetch=True also issues the host->device copy from the
+    prefetch workers, overlapping H2D of batch k+1 with compute of step k
+    — at the cost of holding up to prefetch_depth+1 staged batches in
+    device memory. Set False (one staged batch) for configs sized near the
+    HBM limit.
+
     checkpoint_dir enables MonitoredTrainingSession-style periodic save +
     resume-from-latest (reference run_loop.py:132-138); profile_dir captures
     a JAX profiler trace over profile_steps (the reference's ProfilerHook,
-    run_loop.py:124-126).
+    run_loop.py:124-126). Note with device_prefetch the copies for the
+    first ~prefetch_depth profiled steps were issued before the trace
+    starts and won't appear in it.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -133,7 +142,11 @@ def train(
     )
 
     def make_batch(step):
-        return model.sample(graph, source_fn(step))
+        # With device_prefetch, device_put runs here inside the prefetch
+        # worker, so the host->device copy of batch k+1 overlaps device
+        # compute of step k (the copy releases the GIL).
+        batch = model.sample(graph, source_fn(step))
+        return shard_batch(batch, mesh) if device_prefetch else batch
 
     name = model.metric_name
     history = []
@@ -173,7 +186,8 @@ def train(
         if profile_dir and steps_done - start_step == profile_steps[0]:
             jax.profiler.start_trace(profile_dir)
             profiling = True
-        batch = shard_batch(batch, mesh)
+        if not device_prefetch:
+            batch = shard_batch(batch, mesh)
         state, last_loss, metric = step_fn(state, batch)
         window_metrics.append(metric)
         steps_done += 1
