@@ -20,17 +20,22 @@ def prefetch(
     depth: int = 2,
     num_threads: int = 2,
     start: int = 0,
+    worker_init: Callable[[int], None] | None = None,
 ) -> Iterator[dict]:
     """Yield num_steps batches for steps start..start+num_steps, produced
     ahead of time by worker threads.
 
     make_batch(step) must be thread-safe (the graph engine is: the store is
-    immutable and RNG is thread-local).
+    immutable and RNG is thread-local). worker_init(worker_idx) runs once
+    at the start of each worker thread — e.g. to seed its thread-local
+    sampler RNG for reproducible runs.
     """
     if start:
         base_make = make_batch
         make_batch = lambda step: base_make(step + start)  # noqa: E731
     if num_threads <= 1 or depth <= 0:
+        if worker_init is not None:
+            worker_init(0)
         for step in range(num_steps):
             yield make_batch(step)
         return
@@ -41,7 +46,9 @@ def prefetch(
     consumed = [0]  # steps the consumer has yielded
     stop = threading.Event()
 
-    def worker():
+    def worker(widx: int):
+        if worker_init is not None:
+            worker_init(widx)
         while not stop.is_set():
             with cv:
                 # Backpressure: never run more than `depth` steps ahead of
@@ -66,8 +73,8 @@ def prefetch(
             out.put((step, batch))
 
     threads = [
-        threading.Thread(target=worker, daemon=True)
-        for _ in range(num_threads)
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(num_threads)
     ]
     for t in threads:
         t.start()

@@ -175,6 +175,14 @@ def train(
         window_metrics = []
         t0 = time.time()
 
+    def seed_worker(widx: int):
+        # Deterministic per-worker sampler streams: the native RNG is
+        # thread-local, so each prefetch worker gets its own seeded stream
+        # derived from the run seed (reference samplers are unseeded).
+        from euler_tpu.graph.native import lib
+
+        lib().eg_seed(seed * 1_000_003 + widx + 1)
+
     profiling = False
     for batch in prefetch(
         make_batch,
@@ -182,6 +190,7 @@ def train(
         prefetch_depth,
         prefetch_threads,
         start=start_step,
+        worker_init=seed_worker,
     ):
         if profile_dir and steps_done - start_step == profile_steps[0]:
             jax.profiler.start_trace(profile_dir)
