@@ -1,5 +1,6 @@
 from euler_tpu.parallel.mesh import (
     batch_sharding,
+    force_cpu_devices,
     honor_jax_platforms_env,
     make_mesh,
     pad_tables_for_mesh,
@@ -12,6 +13,7 @@ from euler_tpu.parallel.prefetch import prefetch
 
 __all__ = [
     "batch_sharding",
+    "force_cpu_devices",
     "honor_jax_platforms_env",
     "make_mesh",
     "pad_tables_for_mesh",

@@ -37,6 +37,37 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+def force_cpu_devices(n_devices: int) -> None:
+    """Force an n_devices-wide virtual CPU platform, overriding any ambient
+    JAX_PLATFORMS / XLA_FLAGS (the environment here exports
+    JAX_PLATFORMS=axon, and the axon site hook pre-registers the TPU
+    backend, so env vars alone are a no-op — only jax.config switches the
+    platform before backend init). Must run BEFORE the backend initializes;
+    raises if the backend is already up with too few devices."""
+    import os
+    import re
+
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", opt, flags
+        )
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if len(devs) < n_devices or devs[0].platform != "cpu":
+        raise RuntimeError(
+            f"{len(devs)} {devs[0].platform} devices visible after forcing "
+            f"{n_devices} virtual CPU devices — the JAX backend was "
+            "already initialized; call force_cpu_devices() before any "
+            "jax.devices()/jit in this process"
+        )
+
+
 def make_mesh(
     num_devices: int | None = None,
     devices=None,

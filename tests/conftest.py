@@ -3,20 +3,17 @@ sharding test runs without TPU hardware (the driver separately dry-runs the
 multi-chip path)."""
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The environment preloads jax (axon sitecustomize) with JAX_PLATFORMS=axon,
 # so env vars alone are too late; the backend is still uninitialized at
-# conftest time, so config.update + XLA_FLAGS here take effect.
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+# conftest time, so the config/XLA_FLAGS switch in force_cpu_devices takes
+# effect here.
+from euler_tpu.parallel import force_cpu_devices
 
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
 
 import pytest
 
