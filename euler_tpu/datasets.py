@@ -48,6 +48,7 @@ def build_synthetic(
         sort_keys=True,
     )
     marker = os.path.join(out_dir, "done")
+    wip = os.path.join(out_dir, "synthetic-in-progress")
     if os.path.exists(marker):
         with open(marker) as f:
             if f.read() == params:
@@ -56,10 +57,19 @@ def build_synthetic(
         for name in os.listdir(out_dir):
             if name.endswith(".dat") or name in ("done", "meta.json"):
                 os.unlink(os.path.join(out_dir, name))
+    elif os.path.exists(wip):
+        # a previous synthetic build was interrupted mid-write: the .dat
+        # partitions may be truncated — regenerate them
+        for name in os.listdir(out_dir):
+            if name.endswith(".dat") or name == "meta.json":
+                os.unlink(os.path.join(out_dir, name))
     elif any(n.endswith(".dat") for n in os.listdir(out_dir)):
-        # .dat partitions but no synthetic marker: this is a real converted
-        # dataset — never overwrite it, use it as-is.
+        # .dat partitions but no synthetic marker (neither done nor
+        # in-progress): this is a real converted dataset — never overwrite
+        # it, use it as-is.
         return out_dir
+    with open(wip, "w") as f:
+        f.write(params)
     from euler_tpu.graph.convert import pack_block
 
     rng = np.random.default_rng(seed)
@@ -106,6 +116,7 @@ def build_synthetic(
         o.close()
     with open(marker, "w") as f:
         f.write(params)
+    os.unlink(wip)
     return out_dir
 
 

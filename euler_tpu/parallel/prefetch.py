@@ -47,8 +47,17 @@ def prefetch(
     stop = threading.Event()
 
     def worker(widx: int):
-        if worker_init is not None:
-            worker_init(widx)
+        try:
+            if worker_init is not None:
+                worker_init(widx)
+        except Exception as e:  # surface init errors instead of hanging
+            with cv:
+                # claim the next unclaimed step so the consumer is
+                # guaranteed to reach this error entry
+                step = next_step[0]
+                next_step[0] = step + 1
+            out.put((step, e))
+            return
         while not stop.is_set():
             with cv:
                 # Backpressure: never run more than `depth` steps ahead of

@@ -160,22 +160,26 @@ def build_graph(args):
         import socket
         import time
 
-        # Probe results are cached per filename: entries are immutable
-        # rename-once files, and re-probing dead hosts every poll would
-        # burn the deadline on serial 1s connect timeouts. Only a dead
-        # verdict is cached — a not-yet-listening live shard gets retried.
-        dead: set = set()
+        # Dead verdicts are cached per filename with an expiry: re-probing
+        # dead hosts every 0.1s poll would burn the deadline on serial 1s
+        # connect timeouts, but a permanent verdict would blacklist a shard
+        # whose single probe hit a transient failure (dropped SYN, probe
+        # racing the listen() call). Expired entries get re-probed, so a
+        # not-yet-listening live shard is only deferred, never lost.
+        dead: dict[str, float] = {}  # entry -> verdict expiry time
+        DEAD_TTL = 5.0
 
         def _alive(entry: str) -> bool:
             # registry filename: "<shard>#<host>_<port>" (eg_service.cc)
-            if entry in dead:
+            if dead.get(entry, 0.0) > time.time():
                 return False
             try:
                 host, port = entry.split("#", 1)[1].rsplit("_", 1)
                 with socket.create_connection((host, int(port)), 1.0):
+                    dead.pop(entry, None)
                     return True
             except (OSError, ValueError):
-                dead.add(entry)
+                dead[entry] = time.time() + DEAD_TTL
                 return False
 
         deadline = time.time() + 120.0

@@ -4,13 +4,16 @@ Runs the reference's own json2dat (tools/bin/json2dat.py, loaded with a
 py2->py3 struct shim) on its checked-in testdata graph and asserts our
 converter produces byte-identical output — the format contract that lets
 reference-converted datasets load directly into this engine (and vice
-versa). Skips if the read-only reference checkout is not mounted.
+versa). The reference code executes in a SUBPROCESS, not in the test
+process: the mount is untrusted content, and isolation bounds what it can
+reach (it still shares the filesystem/user, but cannot tamper with the
+asserting interpreter). Skips if the read-only reference checkout is not
+mounted.
 """
 
-import importlib.util
 import json
 import os
-import struct as _struct
+import subprocess
 import sys
 
 import pytest
@@ -23,65 +26,70 @@ pytestmark = pytest.mark.skipif(
     not os.path.exists(REF_CONVERTER), reason="reference not mounted"
 )
 
+# Runs in a subprocess: exec the py2-era reference converter under py3
+# (drop py2 print statements — only in its CLI help/usage paths, not the
+# packing logic — and shim struct.pack to encode str for 's' formats),
+# then convert argv[2]/argv[3] into argv[4].
+_DRIVER = r"""
+import struct as _struct
+import sys
+
+converter_path, meta_path, input_path, out_path = sys.argv[1:5]
+
 
 class _PackShim:
-    """struct.pack shim: the reference converter is python2-era and packs
-    str values for the 's' format; encode them on the way through."""
-
     def __getattr__(self, name):
         return getattr(_struct, name)
 
     @staticmethod
     def pack(fmt, *args):
-        coerced = [
-            a.encode() if isinstance(a, str) else a for a in args
-        ]
+        coerced = [a.encode() if isinstance(a, str) else a for a in args]
         return _struct.pack(fmt, *coerced)
 
 
-def _load_reference_converter():
-    """Exec the reference converter under py3: fix py2 print statements
-    (only in its CLI help/usage paths, not the packing logic) and inject
-    the struct shim."""
-    src = open(REF_CONVERTER).read()
-    lines = []
-    skip_until_quote = False
-    for line in src.splitlines():
-        stripped = line.strip()
-        if skip_until_quote:
-            if "'''" in stripped:
-                skip_until_quote = False
-            continue
-        if stripped.startswith("print '''"):
-            skip_until_quote = "'''" not in stripped[len("print '''"):]
-            indent = line[: len(line) - len(line.lstrip())]
-            lines.append(f"{indent}pass  # py2 print dropped")
-            continue
-        if stripped.startswith("print ") and not stripped.startswith(
-            "print ("
-        ):
-            indent = line[: len(line) - len(line.lstrip())]
-            lines.append(f"{indent}pass  # py2 print dropped")
-            continue
-        lines.append(line)
-    module = type(sys)("ref_json2dat")
-    module.struct = _PackShim()
-    exec(  # noqa: S102 - fixture code from the read-only reference mount
-        compile("\n".join(lines), REF_CONVERTER, "exec"), module.__dict__
+src = open(converter_path).read()
+lines = []
+skip_until_quote = False
+for line in src.splitlines():
+    stripped = line.strip()
+    if skip_until_quote:
+        if "'''" in stripped:
+            skip_until_quote = False
+        continue
+    if stripped.startswith("print '''"):
+        skip_until_quote = "'''" not in stripped[len("print '''"):]
+        indent = line[: len(line) - len(line.lstrip())]
+        lines.append(indent + "pass  # py2 print dropped")
+        continue
+    if stripped.startswith("print ") and not stripped.startswith("print ("):
+        indent = line[: len(line) - len(line.lstrip())]
+        lines.append(indent + "pass  # py2 print dropped")
+        continue
+    lines.append(line)
+
+module = type(sys)("ref_json2dat")
+module.struct = _PackShim()
+exec(compile("\n".join(lines), converter_path, "exec"), module.__dict__)
+module.struct = _PackShim()  # its own `import struct` rebound the global
+module.Converter(meta_path, input_path, out_path).do()
+"""
+
+
+def _run_reference_converter(out_path: str) -> None:
+    subprocess.run(
+        [
+            sys.executable, "-c", _DRIVER, REF_CONVERTER,
+            os.path.join(TESTDATA, "meta.json"),
+            os.path.join(TESTDATA, "graph.json"),
+            out_path,
+        ],
+        check=True, timeout=60, capture_output=True,
     )
-    module.struct = _PackShim()  # its own `import struct` rebound the global
-    return module
 
 
 def test_dat_bytes_identical_to_reference_converter(tmp_path):
     ref_out = str(tmp_path / "ref.dat")
-    mod = _load_reference_converter()
-    conv = mod.Converter(
-        os.path.join(TESTDATA, "meta.json"),
-        os.path.join(TESTDATA, "graph.json"),
-        ref_out,
-    )
-    conv.do()
+    _run_reference_converter(ref_out)
     ref_bytes = open(ref_out, "rb").read()
     assert len(ref_bytes) > 0
 
@@ -100,8 +108,6 @@ def test_dat_bytes_identical_to_reference_converter(tmp_path):
 def test_reference_testdata_loads_into_engine(tmp_path):
     """The reference's 6-node fixture graph converts and loads; spot-check
     structure against the JSON source."""
-    import numpy as np
-
     import euler_tpu
 
     ours = euler_tpu.convert(

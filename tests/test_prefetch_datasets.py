@@ -1,0 +1,89 @@
+"""Prefetch pipeline failure paths + synthetic dataset cache lifecycle."""
+
+import os
+
+import pytest
+
+from euler_tpu.parallel import prefetch
+
+
+def test_prefetch_orders_batches():
+    got = list(prefetch(lambda s: s * 10, 20, depth=3, num_threads=4))
+    assert got == [s * 10 for s in range(20)]
+
+
+def test_prefetch_worker_error_propagates():
+    def make_batch(step):
+        if step == 5:
+            raise ValueError("boom at 5")
+        return step
+
+    it = prefetch(make_batch, 10, depth=2, num_threads=3)
+    got = []
+    with pytest.raises(ValueError, match="boom at 5"):
+        for b in it:
+            got.append(b)
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_prefetch_worker_init_error_raises_not_hangs():
+    """A failing worker_init must surface to the consumer instead of
+    killing every worker silently and blocking forever on the queue."""
+
+    def bad_init(widx):
+        raise RuntimeError("native lib load failed")
+
+    it = prefetch(lambda s: s, 4, depth=2, num_threads=2,
+                  worker_init=bad_init)
+    with pytest.raises(RuntimeError, match="native lib load failed"):
+        list(it)
+
+
+def test_synthetic_interrupted_build_regenerates(tmp_path):
+    """part_*.dat present with the in-progress sentinel (a build killed
+    mid-write) must be rebuilt, not returned as a real converted dataset."""
+    from euler_tpu.datasets import build_synthetic
+
+    kw = dict(num_nodes=20, avg_degree=3, feature_dim=4, label_dim=2,
+              multilabel=True, num_partitions=2)
+    d = str(tmp_path)
+    build_synthetic(d, **kw)
+    assert os.path.exists(os.path.join(d, "done"))
+    assert not os.path.exists(os.path.join(d, "synthetic-in-progress"))
+
+    # simulate an interrupted rebuild: sentinel present, done removed,
+    # one partition truncated
+    os.unlink(os.path.join(d, "done"))
+    with open(os.path.join(d, "synthetic-in-progress"), "w") as f:
+        f.write("params")
+    part = os.path.join(d, "part_0.dat")
+    with open(part, "r+b") as f:
+        f.truncate(10)
+
+    build_synthetic(d, **kw)
+    assert os.path.getsize(part) > 10
+    assert os.path.exists(os.path.join(d, "done"))
+    assert not os.path.exists(os.path.join(d, "synthetic-in-progress"))
+
+    import euler_tpu
+
+    g = euler_tpu.Graph(directory=d)
+    assert g.num_nodes == 20
+
+
+def test_synthetic_real_dataset_never_overwritten(tmp_path):
+    """.dat files with no synthetic marker at all are a real converted
+    dataset: build_synthetic must leave them untouched."""
+    from euler_tpu.datasets import build_synthetic
+
+    d = str(tmp_path)
+    part = os.path.join(d, "part_0.dat")
+    os.makedirs(d, exist_ok=True)
+    with open(part, "wb") as f:
+        f.write(b"real data")
+
+    out = build_synthetic(d, num_nodes=10, avg_degree=2, feature_dim=2,
+                          label_dim=2)
+    assert out == d
+    assert open(part, "rb").read() == b"real data"
+    assert not os.path.exists(os.path.join(d, "done"))
