@@ -141,6 +141,17 @@ def train(
         donate_argnums=(0,),
     )
 
+    if device_prefetch and len(mesh.devices.reshape(-1)) > 1 and (
+        mesh.devices.reshape(-1)[0].platform == "cpu"
+    ):
+        # XLA's CPU multi-device backend shares one in-process communicator:
+        # device_put issued from prefetch worker threads can starve a
+        # collective rendezvous inside a concurrently executing step (7 of 8
+        # participants arrive, then a fatal 40s termination timeout). Real
+        # TPU/GPU devices transfer asynchronously and don't have this
+        # hazard; on a virtual CPU mesh, transfer on the consumer thread.
+        device_prefetch = False
+
     def make_batch(step):
         # With device_prefetch, device_put runs here inside the prefetch
         # worker, so the host->device copy of batch k+1 overlaps device
