@@ -269,6 +269,10 @@ class ScalableGCN(base.ScalableStoreModel):
         self.num_layers = num_layers
         self.dim = dim
         self.max_id = max_id
+        # Per-ROOT caps: the reference expands the full ragged 1-hop
+        # neighborhood (encoders.py:262 get_multi_hop_neighbor); for static
+        # TPU shapes we pad to batch * max_neighbors unique neighbors and
+        # batch * max_edges adjacency entries per sampled batch.
         self.max_neighbors = max_neighbors
         self.max_edges = max_edges if max_edges is not None else max_neighbors * 4
         self.feature_idx = feature_idx
@@ -300,8 +304,8 @@ class ScalableGCN(base.ScalableStoreModel):
             graph,
             roots,
             [self.edge_type],
-            max_nodes_per_hop=[self.max_neighbors],
-            max_edges_per_hop=[self.max_edges],
+            max_nodes_per_hop=[B * self.max_neighbors],
+            max_edges_per_hop=[B * self.max_edges],
             default_node=self.max_id + 1,
         )
         hop = hops[0]
