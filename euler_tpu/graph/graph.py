@@ -70,22 +70,41 @@ class Graph:
         retries: int = 3,
         timeout_ms: int = 5000,
         quarantine_ms: int = 3000,
+        cache_dir: str | None = None,
     ):
         self._lib = lib()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
-        for path in [directory or "", registry or ""] + list(files or []):
-            if path.startswith(("hdfs://", "s3://", "gs://")):
-                # The reference reads graph data straight off HDFS via
-                # libhdfs (reference euler/common/hdfs_file_io.cc:79-80);
-                # TPU hosts mount data as local/NFS paths instead, so
-                # remote filesystems are gated, not linked in.
-                raise NotImplementedError(
-                    f"remote filesystem paths are not supported ({path}); "
-                    "copy or mount the .dat partitions locally (e.g. "
-                    "gsutil/distcp to a local or NFS directory) and pass "
-                    "that directory"
-                )
+        # Remote filesystems (the reference reads graph data straight off
+        # HDFS, euler/common/hdfs_file_io.cc:79-80): any fsspec URL is
+        # staged shard-aware to a local cache, then loaded through the one
+        # fast local path (see euler_tpu/graph/remote_fs.py).
+        from euler_tpu.graph import remote_fs
+
+        if mode == "local":
+            # directory=/files= are only consumed by the embedded engine;
+            # remote mode must not stage data it will never read
+            if directory is not None:
+                if remote_fs.is_remote_path(directory):
+                    directory = remote_fs.stage_directory(
+                        directory,
+                        cache_dir=cache_dir,
+                        shard_idx=shard_idx,
+                        shard_num=shard_num,
+                    )
+                    # staging already applied the shard selection; the
+                    # native re-filter on the staged names is a no-op
+                else:
+                    directory = remote_fs.strip_local_scheme(directory)
+            if files:
+                files = remote_fs.stage_files(files, cache_dir=cache_dir)
+        if registry is not None and remote_fs.is_remote_path(registry):
+            raise NotImplementedError(
+                f"registry on a remote filesystem is not supported "
+                f"({registry}); the registry is a liveness-watched "
+                "directory — use a local/NFS path or an explicit "
+                "shards= list"
+            )
         self.mode = mode
         if mode == "remote":
             if registry:

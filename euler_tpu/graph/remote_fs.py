@@ -1,0 +1,211 @@
+"""Remote-filesystem graph ingestion: stage fsspec URLs to a local cache.
+
+Role equivalent of the reference's HDFS FileIO
+(reference euler/common/hdfs_file_io.cc:79-80 reads graph partitions
+straight off HDFS via libhdfs, selected through the scheme-keyed factory at
+euler/common/file_io_factory.cc). The TPU-native reshape: the sampling
+engine keeps one fast local read path (mmap-friendly, no network stalls in
+the hot loop) and remote schemes — ``gs://``, ``s3://``, ``hdfs://``,
+``memory://``, anything fsspec resolves — are staged once to a local cache
+directory before the engine loads. That is also how TPU VMs are actually
+fed (data staged to local SSD), and it is shard-aware: a shard downloads
+only its own partitions, mirroring the native selection rule
+(eg_engine.cc Engine::Load: partition index p from ``*_<p>.dat``,
+kept when ``p % shard_num == shard_idx``).
+
+Staging is idempotent and crash-safe: files land under a tmp name and are
+renamed into place; a file already cached with the same size is not
+re-fetched. Protocol drivers install separately (e.g. gcsfs for ``gs://``);
+a missing driver raises with the package name instead of an opaque import
+error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import shutil
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_PART_RE = re.compile(r"_(\d+)\.dat$")
+
+#: schemes that are plain local paths even though they carry a "://"
+_LOCAL_SCHEMES = ("file", "local")
+
+
+def is_remote_path(path: str) -> bool:
+    """True for fsspec-style URLs that need staging (gs://, s3://, ...)."""
+    if "://" not in path:
+        return False
+    scheme = path.split("://", 1)[0]
+    return scheme not in _LOCAL_SCHEMES
+
+
+def strip_local_scheme(path: str) -> str:
+    """file:///data/x -> /data/x; plain paths pass through."""
+    for scheme in _LOCAL_SCHEMES:
+        prefix = scheme + "://"
+        if path.startswith(prefix):
+            return path[len(prefix):] or "/"
+    return path
+
+
+def default_cache_dir() -> str:
+    return os.environ.get(
+        "EULER_TPU_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "euler_tpu", "staged"
+        ),
+    )
+
+
+def _filesystem(url: str):
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover - fsspec is a base dep here
+        raise RuntimeError(
+            f"loading {url} needs the fsspec package"
+        ) from e
+    try:
+        return fsspec.core.url_to_fs(url)
+    except (ImportError, ValueError) as e:
+        scheme = url.split("://", 1)[0]
+        raise RuntimeError(
+            f"no fsspec driver installed for {scheme}:// "
+            f"(install e.g. gcsfs for gs://, s3fs for s3://): {e}"
+        ) from e
+
+
+def partition_index(name: str) -> int:
+    """Trailing ``_<p>.dat`` partition index; -1 when absent.
+
+    Mirrors the native parser (eg_engine.cc:14-16) so remote staging and
+    local loading select identical file sets.
+    """
+    m = _PART_RE.search(os.path.basename(name))
+    return int(m.group(1)) if m else -1
+
+
+def _fetch(fs, remote: str, local: str) -> None:
+    # tmp name unique per process AND thread: concurrent stagers (worker
+    # processes or threads on one host) must never interleave writes into
+    # the same partial file; os.replace publishes only complete files
+    tmp = f"{local}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        fs.get_file(remote, tmp)
+        os.replace(tmp, local)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def stage_directory(
+    url: str,
+    cache_dir: str | None = None,
+    shard_idx: int = 0,
+    shard_num: int = 1,
+    refresh: bool = False,
+) -> str:
+    """Download a remote graph directory's ``.dat`` partitions (and
+    meta.json when present) for this shard; return the local directory.
+
+    The cache key includes the URL and the shard selection, so different
+    shards staged on one host do not collide.
+    """
+    fs, root = _filesystem(url)
+    key = hashlib.sha1(
+        f"{url}|{shard_idx}/{shard_num}".encode()
+    ).hexdigest()[:16]
+    out = os.path.join(cache_dir or default_cache_dir(), key)
+    os.makedirs(out, exist_ok=True)
+
+    entries = fs.ls(root, detail=True)
+    picked = []
+    meta = None
+    for ent in entries:
+        name = os.path.basename(ent["name"])
+        if name == "meta.json":
+            meta = ent
+            continue
+        if not name.endswith(".dat"):
+            continue
+        p = partition_index(name)
+        # p = -1 (unpartitioned) is skipped under sharding, exactly like
+        # the native rule (C++ -1 % n is negative, never == shard_idx;
+        # Python's modulo differs, so spell it out)
+        if shard_num > 1 and (p < 0 or p % shard_num != shard_idx):
+            continue
+        picked.append(ent)
+    if not picked:
+        raise FileNotFoundError(
+            f"no .dat partitions for shard {shard_idx}/{shard_num} in {url}"
+        )
+
+    want = picked + ([meta] if meta else [])
+    keep = {os.path.basename(e["name"]) for e in want}
+    # drop cache entries absent from the current remote listing — a
+    # repartitioned dataset at the same URL must not mix old and new
+    # files when eg_load scans the staged directory
+    for name in os.listdir(out):
+        if name not in keep and ".tmp." not in name:
+            # (.tmp.* files may belong to a concurrent stager mid-fetch)
+            os.unlink(os.path.join(out, name))
+
+    def fetch_one(ent):
+        name = os.path.basename(ent["name"])
+        local = os.path.join(out, name)
+        size = ent.get("size")
+        if (
+            not refresh
+            and os.path.exists(local)
+            and size is not None
+            and os.path.getsize(local) == size
+        ):
+            return
+        _fetch(fs, ent["name"], local)
+
+    # concurrent fetches: object stores serve objects far below host
+    # bandwidth; distinct files are safe to fetch in parallel
+    with ThreadPoolExecutor(max_workers=min(8, len(want))) as ex:
+        list(ex.map(fetch_one, want))
+    return out
+
+
+def stage_files(
+    urls: list[str],
+    cache_dir: str | None = None,
+    refresh: bool = False,
+) -> list[str]:
+    """Stage an explicit file list; local paths pass through untouched."""
+    out = []
+    for url in urls:
+        if not is_remote_path(url):
+            out.append(strip_local_scheme(url))
+            continue
+        fs, path = _filesystem(url)
+        key = hashlib.sha1(url.encode()).hexdigest()[:16]
+        d = os.path.join(cache_dir or default_cache_dir(), key)
+        os.makedirs(d, exist_ok=True)
+        local = os.path.join(d, os.path.basename(path))
+        try:
+            size = fs.info(path).get("size")
+        except FileNotFoundError:
+            raise FileNotFoundError(f"no such remote file: {url}")
+        fresh = (
+            not refresh
+            and os.path.exists(local)
+            and size is not None
+            and os.path.getsize(local) == size
+        )
+        if not fresh:
+            _fetch(fs, path, local)
+        out.append(local)
+    return out
+
+
+def clear_cache(cache_dir: str | None = None) -> None:
+    d = cache_dir or default_cache_dir()
+    if os.path.isdir(d):
+        shutil.rmtree(d)

@@ -32,6 +32,16 @@ class GraphService:
         registry: str | None = None,
     ):
         self._lib = lib()
+        from euler_tpu.graph import remote_fs
+
+        if remote_fs.is_remote_path(data_dir):
+            # shared/multi-host mode is the path that most needs remote
+            # data: stage this shard's partitions before the native loader
+            data_dir = remote_fs.stage_directory(
+                data_dir, shard_idx=shard_idx, shard_num=shard_num
+            )
+        else:
+            data_dir = remote_fs.strip_local_scheme(data_dir)
         self._h = self._lib.eg_service_start(
             data_dir.encode(),
             shard_idx,

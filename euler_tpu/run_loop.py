@@ -318,8 +318,9 @@ def build_model(args, graph):
             num_layers=len(fanouts),
             dim=args.dim,
             max_id=args.max_id,
-            # dense cap on the batch's unique 1-hop neighborhood
-            max_neighbors=args.batch_size * fanouts[0],
+            # per-ROOT cap on unique 1-hop neighbors (the model multiplies
+            # by the batch size at sample time)
+            max_neighbors=fanouts[0],
             aggregator=args.aggregator,
             use_residual=args.use_residual,
             store_learning_rate=args.store_learning_rate,
