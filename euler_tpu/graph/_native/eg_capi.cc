@@ -7,10 +7,12 @@
 // variable-shape calls return an EGResult handle the caller drains and frees.
 #include <cstdint>
 #include <cstring>
+#include <map>
 #include <string>
 #include <vector>
 
 #include "eg_engine.h"
+#include "eg_registry.h"
 #include "eg_stats.h"
 #include "eg_remote.h"
 #include "eg_service.h"
@@ -18,6 +20,8 @@
 using eg::EGResult;
 using eg::Engine;
 using eg::GraphAPI;
+using eg::RegistryList;
+using eg::RegistryServer;
 using eg::RemoteGraph;
 using eg::Service;
 
@@ -96,6 +100,46 @@ void* eg_service_start(const char* data_dir, int shard_idx, int shard_num,
 int eg_service_port(void* s) { return static_cast<Service*>(s)->port(); }
 
 void eg_service_stop(void* s) { delete static_cast<Service*>(s); }
+
+// ---- TCP shard registry (ZooKeeper discovery equivalent,
+// reference euler/common/zk_server_register.cc + zk_server_monitor.cc) ----
+void* eg_registry_start(const char* host, int port, int ttl_ms) {
+  auto* r = new RegistryServer();
+  if (!r->Start(host ? host : "", port, ttl_ms)) {
+    g_last_error = r->error();
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+int eg_registry_port(void* r) {
+  return static_cast<RegistryServer*>(r)->port();
+}
+
+void eg_registry_stop(void* r) { delete static_cast<RegistryServer*>(r); }
+
+// LIST a registry at host:port into caller-supplied buf as
+// "<shard> <host>:<port>\n" lines. Returns bytes written, or -1 when the
+// registry is unreachable. A listing larger than cap is truncated at the
+// last complete line (never mid-entry, so the result always parses).
+int eg_registry_query(const char* host, int port, int timeout_ms, char* buf,
+                      int cap) {
+  std::map<int, std::vector<std::string>> listed;
+  if (!RegistryList(host ? host : "127.0.0.1", port, timeout_ms, &listed))
+    return -1;
+  std::string out;
+  for (auto& [shard, addrs] : listed)
+    for (auto& a : addrs)
+      out += std::to_string(shard) + " " + a + "\n";
+  size_t n = out.size();
+  if (n > static_cast<size_t>(cap)) {
+    size_t nl = out.rfind('\n', static_cast<size_t>(cap) - 1);
+    n = nl == std::string::npos ? 0 : nl + 1;
+  }
+  if (n > 0) memcpy(buf, out.data(), n);
+  return static_cast<int>(n);
+}
 
 // ---- introspection ----
 int64_t eg_num_nodes(void* h) { return API(h)->NumNodes(); }

@@ -1,0 +1,155 @@
+#include "eg_registry.h"
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+#include "eg_wire.h"
+
+namespace eg {
+
+bool RegistryServer::Start(const std::string& host, int port, int ttl_ms) {
+  ttl_ms_ = ttl_ms > 0 ? ttl_ms : 10000;
+  listen_fd_ = ListenTcp(host.empty() ? "0.0.0.0" : host, port, &port_);
+  if (listen_fd_ < 0) {
+    error_ = "registry: cannot bind " + host + ":" + std::to_string(port);
+    return false;
+  }
+  stopping_ = false;
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return true;
+}
+
+void RegistryServer::Stop() {
+  if (listen_fd_ < 0) return;
+  stopping_ = true;
+  ::shutdown(listen_fd_, SHUT_RDWR);
+  ::close(listen_fd_);
+  if (accept_thread_.joinable()) accept_thread_.join();
+  listen_fd_ = -1;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  while (active_conns_.load(std::memory_order_acquire) > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+}
+
+void RegistryServer::AcceptLoop() {
+  while (!stopping_) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (stopping_) break;
+      continue;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      conn_fds_.insert(fd);
+    }
+    active_conns_.fetch_add(1, std::memory_order_acq_rel);
+    std::thread([this, fd] {
+      HandleConn(fd);
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        conn_fds_.erase(fd);
+      }
+      ::close(fd);
+      active_conns_.fetch_sub(1, std::memory_order_acq_rel);
+    }).detach();
+  }
+}
+
+void RegistryServer::HandleConn(int fd) {
+  std::string req;
+  while (!stopping_ && RecvFrame(fd, &req)) {
+    std::string reply = Dispatch(req);
+    if (!SendFrame(fd, reply)) break;
+  }
+}
+
+std::string RegistryServer::Dispatch(const std::string& req) {
+  std::istringstream ss(req);
+  std::string op;
+  ss >> op;
+  auto now = std::chrono::steady_clock::now();
+  if (op == "REG" || op == "UNREG") {
+    int shard = -1;
+    std::string addr;
+    ss >> shard >> addr;
+    if (shard < 0 || addr.empty()) return "ERR bad request";
+    std::lock_guard<std::mutex> l(mu_);
+    if (op == "REG")
+      entries_[{shard, addr}] = now + std::chrono::milliseconds(ttl_ms_);
+    else
+      entries_.erase({shard, addr});
+    // reply carries the TTL so registrants can pace heartbeats to it
+    return "OK " + std::to_string(ttl_ms_);
+  }
+  if (op == "LIST") {
+    std::ostringstream out;
+    std::lock_guard<std::mutex> l(mu_);
+    for (auto it = entries_.begin(); it != entries_.end();) {
+      if (it->second < now) {
+        it = entries_.erase(it);  // expired: the ephemeral-znode analog
+      } else {
+        out << it->first.first << " " << it->first.second << "\n";
+        ++it;
+      }
+    }
+    return out.str();
+  }
+  return "ERR unknown op";
+}
+
+// ---- client side ----
+
+bool ParseTcpRegistry(const std::string& s, std::string* host, int* port) {
+  const std::string prefix = "tcp://";
+  if (s.compare(0, prefix.size(), prefix) != 0) return false;
+  std::string rest = s.substr(prefix.size());
+  size_t colon = rest.rfind(':');
+  if (colon == std::string::npos || colon == 0) return false;
+  *host = rest.substr(0, colon);
+  *port = std::atoi(rest.c_str() + colon + 1);
+  return *port > 0;
+}
+
+bool RegistrySend(int fd, const std::string& line, int* ttl_ms) {
+  if (fd < 0 || !SendFrame(fd, line)) return false;
+  std::string reply;
+  if (!RecvFrame(fd, &reply) || reply.compare(0, 2, "OK") != 0) return false;
+  if (ttl_ms && reply.size() > 3) {
+    int t = std::atoi(reply.c_str() + 3);
+    if (t > 0) *ttl_ms = t;
+  }
+  return true;
+}
+
+bool RegistryList(const std::string& host, int port, int timeout_ms,
+                  std::map<int, std::vector<std::string>>* out) {
+  int fd = DialTcp(host, port, timeout_ms);
+  if (fd < 0) return false;
+  std::string reply;
+  bool ok = SendFrame(fd, "LIST") && RecvFrame(fd, &reply);
+  ::close(fd);
+  if (!ok) return false;
+  std::istringstream ss(reply);
+  std::string line;
+  while (std::getline(ss, line)) {
+    std::istringstream ls(line);
+    int shard = -1;
+    std::string addr;
+    ls >> shard >> addr;
+    if (shard >= 0 && !addr.empty()) (*out)[shard].push_back(addr);
+  }
+  return true;
+}
+
+}  // namespace eg

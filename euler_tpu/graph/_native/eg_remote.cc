@@ -11,6 +11,8 @@
 #include <sstream>
 #include <thread>
 
+#include "eg_registry.h"
+
 namespace eg {
 
 namespace {
@@ -131,7 +133,32 @@ bool RemoteGraph::Init(const std::string& config) {
 
   // shard -> replica address list
   std::map<int, std::vector<std::pair<std::string, int>>> shards;
-  if (cfg.count("registry")) {
+  std::string reg_host;
+  int reg_port = 0;
+  if (cfg.count("registry") &&
+      cfg["registry"].compare(0, 6, "tcp://") == 0) {
+    // TCP registry discovery (eg_registry.h): LIST returns only live
+    // (unexpired) entries — the watch-children analog of the reference's
+    // ZK monitor (zk_server_monitor.cc:50-64).
+    if (!ParseTcpRegistry(cfg["registry"], &reg_host, &reg_port)) {
+      error_ = "bad tcp registry url " + cfg["registry"] +
+               " (want tcp://host:port)";
+      return false;
+    }
+    std::map<int, std::vector<std::string>> listed;
+    if (!RegistryList(reg_host, reg_port, timeout_ms_, &listed)) {
+      error_ = "cannot reach tcp registry " + cfg["registry"];
+      return false;
+    }
+    for (auto& [shard, addrs] : listed) {
+      for (auto& a : addrs) {
+        std::string host;
+        int port;
+        if (ParseHostPort(a, &host, &port))
+          shards[shard].emplace_back(host, port);
+      }
+    }
+  } else if (cfg.count("registry")) {
     DIR* d = opendir(cfg["registry"].c_str());
     if (!d) {
       error_ = "cannot open registry dir " + cfg["registry"];

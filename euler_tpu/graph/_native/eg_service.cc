@@ -9,6 +9,7 @@
 #include <cstdio>
 #include <fstream>
 
+#include "eg_registry.h"
 #include "eg_stats.h"
 #include "eg_wire.h"
 
@@ -77,7 +78,51 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
   stopping_ = false;
   accept_thread_ = std::thread([this] { AcceptLoop(); });
 
-  if (!registry_dir.empty()) {
+  if (registry_dir.compare(0, 6, "tcp://") == 0) {
+    // TCP registry (eg_registry.h): REG now, then heartbeat re-REG at a
+    // third of the registry's TTL (returned in the REG reply) so the
+    // entry stays live — the ephemeral-znode session analog
+    // (zk_server_register.cc:32-48). The initial registration must
+    // succeed (fail fast on a wrong address); later heartbeats tolerate
+    // registry restarts by redialing.
+    if (!ParseTcpRegistry(registry_dir, &reg_host_, &reg_port_)) {
+      error_ = "bad tcp registry url " + registry_dir +
+               " (want tcp://host:port)";
+      Stop();
+      return false;
+    }
+    const std::string line = "REG " + std::to_string(shard_idx_) + " " +
+                             host_ + ":" + std::to_string(port_);
+    int ttl_ms = 10000;
+    int fd = DialTcp(reg_host_, reg_port_, 2000);
+    if (fd < 0 || !RegistrySend(fd, line, &ttl_ms)) {
+      if (fd >= 0) ::close(fd);
+      error_ = "cannot register with tcp registry " + registry_dir;
+      Stop();
+      return false;
+    }
+    heartbeat_stop_ = false;
+    heartbeat_thread_ = std::thread([this, line, fd, ttl_ms]() mutable {
+      while (!heartbeat_stop_.load(std::memory_order_acquire)) {
+        // wake every 50 ms so Stop() stays prompt even with short TTLs
+        int beat_ms = ttl_ms / 3 > 150 ? ttl_ms / 3 : 150;
+        for (int slept = 0; slept < beat_ms && !heartbeat_stop_;
+             slept += 50)
+          std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        if (heartbeat_stop_) break;
+        if (fd < 0 || !RegistrySend(fd, line, &ttl_ms)) {
+          if (fd >= 0) ::close(fd);
+          fd = DialTcp(reg_host_, reg_port_, 2000);
+          if (fd >= 0) RegistrySend(fd, line, &ttl_ms);
+        }
+      }
+      if (fd >= 0) {
+        RegistrySend(fd, "UNREG " + std::to_string(shard_idx_) + " " +
+                             host_ + ":" + std::to_string(port_));
+        ::close(fd);
+      }
+    });
+  } else if (!registry_dir.empty()) {
     // "<shard>#<host>_<port>" file, written via rename for atomicity — the
     // flat-file stand-in for the reference's ephemeral znode
     // (zk_server_register.cc:32-48).
@@ -113,6 +158,10 @@ void Service::Stop() {
   if (!registry_file_.empty()) {
     ::unlink(registry_file_.c_str());
     registry_file_.clear();
+  }
+  if (heartbeat_thread_.joinable()) {
+    heartbeat_stop_.store(true, std::memory_order_release);
+    heartbeat_thread_.join();  // sends the UNREG on its way out
   }
 }
 

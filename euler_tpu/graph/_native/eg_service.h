@@ -38,7 +38,10 @@ class Service {
 
   // Loads shard `shard_idx` of `shard_num` from data_dir, binds host:port
   // (port 0 = ephemeral) and starts serving. If registry_dir is non-empty,
-  // registers there. False + error() on failure.
+  // registers there: either a shared directory (flat file) or
+  // "tcp://host:port" of a RegistryServer (heartbeat re-registration keeps
+  // the TTL entry alive — the ephemeral-znode analog, eg_registry.h).
+  // False + error() on failure.
   bool Start(const std::string& data_dir, int shard_idx, int shard_num,
              const std::string& host, int port,
              const std::string& registry_dir);
@@ -61,6 +64,11 @@ class Service {
   int port_ = 0;
   int shard_idx_ = 0, shard_num_ = 1, num_partitions_ = 1;
   std::string registry_file_;
+  // tcp:// registry registration (empty host = not in tcp mode)
+  std::string reg_host_;
+  int reg_port_ = 0;
+  std::thread heartbeat_thread_;
+  std::atomic<bool> heartbeat_stop_{false};
 
   int listen_fd_ = -1;
   std::atomic<bool> stopping_{false};

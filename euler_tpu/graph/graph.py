@@ -98,11 +98,16 @@ class Graph:
                     directory = remote_fs.strip_local_scheme(directory)
             if files:
                 files = remote_fs.stage_files(files, cache_dir=cache_dir)
-        if registry is not None and remote_fs.is_remote_path(registry):
+        if (
+            registry is not None
+            and not registry.startswith("tcp://")
+            and remote_fs.is_remote_path(registry)
+        ):
             raise NotImplementedError(
                 f"registry on a remote filesystem is not supported "
                 f"({registry}); the registry is a liveness-watched "
-                "directory — use a local/NFS path or an explicit "
+                "directory — use a local/NFS path, tcp://host:port of a "
+                "euler_tpu.graph.registry server, or an explicit "
                 "shards= list"
             )
         self.mode = mode

@@ -67,6 +67,14 @@ def lib() -> ctypes.CDLL:
     )
     _sig(L.eg_service_port, c.c_int, [p])
     _sig(L.eg_service_stop, None, [p])
+    _sig(L.eg_registry_start, p, [c.c_char_p, c.c_int, c.c_int])
+    _sig(L.eg_registry_port, c.c_int, [p])
+    _sig(L.eg_registry_stop, None, [p])
+    _sig(
+        L.eg_registry_query,
+        c.c_int,
+        [c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int],
+    )
     _sig(L.eg_num_nodes, c.c_int64, [p])
     _sig(L.eg_num_edges, c.c_int64, [p])
     _sig(L.eg_node_type_num, c.c_int32, [p])

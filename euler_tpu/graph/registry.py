@@ -1,0 +1,126 @@
+"""TCP shard registry: multi-host discovery without a shared filesystem.
+
+Role equivalent of the reference's ZooKeeper coordination plane
+(reference euler/common/zk_server_register.cc creates ephemeral znodes
+"<shard>#<ip:port>"; zk_server_monitor.cc:50-64 watches them). Here a tiny
+native TCP server (eg_registry.cc) holds soft TTL state: shard servers
+REGister and heartbeat; entries of dead shards expire on their own; clients
+LIST live shards. Run it from the training coordinator —
+
+    registry = RegistryServer(port=9100)            # in-process
+    python -m euler_tpu.graph.registry --port 9100  # or standalone
+
+— then point every shard server and client at ``tcp://<coordinator>:9100``
+via the same ``registry=`` parameter that otherwise takes a shared
+directory (GraphService / Graph(mode="remote") / run_loop --registry).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from euler_tpu.graph.native import lib
+
+
+class RegistryServer:
+    """The registry service; stops on close() or GC.
+
+    ttl_ms is the ephemeral-entry lifetime: a shard that misses heartbeats
+    for this long disappears from LIST (shards re-REG every ~3 s, so the
+    10 s default tolerates two lost heartbeats).
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 ttl_ms: int = 10000):
+        self._lib = lib()
+        self._h = self._lib.eg_registry_start(host.encode(), port, ttl_ms)
+        if not self._h:
+            err = self._lib.eg_last_error().decode()
+            raise RuntimeError(f"registry start failed: {err}")
+        self.host = host
+        self.port = self._lib.eg_registry_port(self._h)
+
+    @property
+    def address(self) -> str:
+        host = "127.0.0.1" if self.host in ("", "0.0.0.0") else self.host
+        return f"tcp://{host}:{self.port}"
+
+    def stop(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.eg_registry_stop(self._h)
+            self._h = None
+
+    close = stop
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def parse_tcp_url(url: str) -> tuple[str, int] | None:
+    """'tcp://host:port' -> (host, port); None when not a tcp URL."""
+    if not url.startswith("tcp://"):
+        return None
+    rest = url[len("tcp://"):]
+    host, sep, port_s = rest.rpartition(":")
+    if not sep or not host or not port_s.isdigit():
+        raise ValueError(f"bad tcp registry url: {url}")
+    return host, int(port_s)
+
+
+def query(url: str, timeout_ms: int = 2000) -> dict[int, list[str]]:
+    """LIST a registry: {shard: [\"host:port\", ...]} of live entries.
+
+    Raises ConnectionError when the registry is unreachable.
+    """
+    parsed = parse_tcp_url(url)
+    if parsed is None:
+        raise ValueError(f"not a tcp:// registry url: {url}")
+    host, port = parsed
+    L = lib()
+    buf = ctypes.create_string_buffer(1 << 20)
+    n = L.eg_registry_query(
+        host.encode(), port, timeout_ms, buf, len(buf)
+    )
+    if n < 0:
+        raise ConnectionError(f"registry unreachable: {url}")
+    out: dict[int, list[str]] = {}
+    for line in buf.raw[:n].decode().splitlines():
+        shard_s, _, addr = line.partition(" ")
+        if addr:
+            out.setdefault(int(shard_s), []).append(addr)
+    return out
+
+
+def main() -> None:
+    import argparse
+    import signal
+    import time
+
+    ap = argparse.ArgumentParser(
+        description="Run the TCP shard registry (coordination plane)."
+    )
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=9100)
+    ap.add_argument("--ttl_ms", type=int, default=10000)
+    args = ap.parse_args()
+    reg = RegistryServer(args.host, args.port, args.ttl_ms)
+    print(f"shard registry serving on {reg.address}", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    reg.stop()
+
+
+if __name__ == "__main__":
+    main()

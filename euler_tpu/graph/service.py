@@ -5,9 +5,11 @@ Role equivalent of the reference's service launcher
 libeuler_service.so and runs StartService on a daemon thread): here the
 native Service (eg_service.cc) runs its own accept/handler threads, so
 ``GraphService(...)`` returns as soon as the shard has loaded its partitions
-and bound its port. Discovery is a flat-file registry directory instead of
-ZooKeeper (see eg_service.h) — on a multi-host TPU pod, point every host at
-the same shared-filesystem registry dir.
+and bound its port. Discovery replaces ZooKeeper with either a flat-file
+registry directory (shared filesystem) or a TCP registry
+(``registry="tcp://host:port"`` of a euler_tpu.graph.registry server, for
+multi-host pods without a shared FS; the shard heartbeats to keep its
+TTL entry alive — see eg_registry.h).
 
 Also runnable as a standalone shard process:
     python -m euler_tpu.graph.service --data_dir d --shard_idx 0 \

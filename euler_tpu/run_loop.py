@@ -144,6 +144,32 @@ def build_graph(args):
     else:  # shared: serve this process's shard, then connect remote
         if not args.registry:
             raise ValueError("--graph_mode=shared needs --registry")
+        import time
+
+        from euler_tpu.graph import registry as registry_mod
+
+        tcp_registry = args.registry.startswith("tcp://")
+        if tcp_registry:
+            # TCP coordination plane (no shared filesystem needed):
+            # process 0 hosts the registry at the URL's port; every other
+            # process waits for it to answer before registering its shard.
+            host, port = registry_mod.parse_tcp_url(args.registry)
+            if args.process_id == 0:
+                services.append(registry_mod.RegistryServer(port=port))
+            else:
+                deadline = time.time() + 120.0
+                while True:
+                    try:
+                        registry_mod.query(args.registry)
+                        break
+                    except ConnectionError:
+                        if time.time() > deadline:
+                            raise TimeoutError(
+                                f"registry {args.registry} unreachable "
+                                "after 120s (does process 0 run on "
+                                f"{host}?)"
+                            )
+                        time.sleep(0.2)
         services.append(
             euler_tpu.GraphService(
                 args.data_dir,
@@ -152,51 +178,69 @@ def build_graph(args):
                 registry=args.registry,
             )
         )
-        # Wait for every shard to register AND accept connections before
-        # connecting. A liveness probe (TCP connect) filters out stale
-        # entries left by a SIGKILLed prior run with the same --registry —
-        # those would otherwise satisfy a pure count check and produce a
-        # confusing connect failure later.
-        import socket
-        import time
+        if tcp_registry:
+            # Entries are heartbeat-kept with a TTL, so LIST only ever
+            # returns live shards — no extra probing needed (stale
+            # entries from a killed run expire on their own).
+            def live_shards() -> set:
+                try:
+                    return set(registry_mod.query(args.registry))
+                except ConnectionError:
+                    return set()
 
-        # Dead verdicts are cached per filename with an expiry: re-probing
-        # dead hosts every 0.1s poll would burn the deadline on serial 1s
-        # connect timeouts, but a permanent verdict would blacklist a shard
-        # whose single probe hit a transient failure (dropped SYN, probe
-        # racing the listen() call). Expired entries get re-probed, so a
-        # not-yet-listening live shard is only deferred, never lost.
-        dead: dict[str, float] = {}  # entry -> verdict expiry time
-        DEAD_TTL = 5.0
+            stale_hint = ""
+        else:
+            # Flat-file registry: wait for every shard to register AND
+            # accept connections before connecting. A liveness probe (TCP
+            # connect) filters out stale entries left by a SIGKILLed prior
+            # run with the same --registry — those would otherwise satisfy
+            # a pure count check and produce a confusing connect failure
+            # later.
+            import socket
 
-        def _alive(entry: str) -> bool:
-            # registry filename: "<shard>#<host>_<port>" (eg_service.cc)
-            if dead.get(entry, 0.0) > time.time():
-                return False
-            try:
-                host, port = entry.split("#", 1)[1].rsplit("_", 1)
-                with socket.create_connection((host, int(port)), 1.0):
-                    dead.pop(entry, None)
-                    return True
-            except (OSError, ValueError):
-                dead[entry] = time.time() + DEAD_TTL
-                return False
+            # Dead verdicts are cached per filename with an expiry:
+            # re-probing dead hosts every 0.1s poll would burn the
+            # deadline on serial 1s connect timeouts, but a permanent
+            # verdict would blacklist a shard whose single probe hit a
+            # transient failure (dropped SYN, probe racing the listen()
+            # call). Expired entries get re-probed, so a not-yet-listening
+            # live shard is only deferred, never lost.
+            dead: dict[str, float] = {}  # entry -> verdict expiry time
+            DEAD_TTL = 5.0
+
+            def _alive(entry: str) -> bool:
+                # registry filename: "<shard>#<host>_<port>" (eg_service.cc)
+                if dead.get(entry, 0.0) > time.time():
+                    return False
+                try:
+                    host, port = entry.split("#", 1)[1].rsplit("_", 1)
+                    with socket.create_connection((host, int(port)), 1.0):
+                        dead.pop(entry, None)
+                        return True
+                except (OSError, ValueError):
+                    dead[entry] = time.time() + DEAD_TTL
+                    return False
+
+            def live_shards() -> set:
+                return {
+                    f.split("#", 1)[0]
+                    for f in os.listdir(args.registry)
+                    if "#" in f and not f.endswith(".tmp") and _alive(f)
+                }
+
+            stale_hint = ("; stale entries from a killed run are ignored "
+                          "— clear the registry dir")
 
         deadline = time.time() + 120.0
         while True:
-            live = {
-                f.split("#", 1)[0]
-                for f in os.listdir(args.registry)
-                if "#" in f and not f.endswith(".tmp") and _alive(f)
-            }
+            live = live_shards()
             if len(live) >= args.num_processes:
                 break
             if time.time() > deadline:
                 raise TimeoutError(
                     f"only live shards {sorted(live)} in "
                     f"{args.registry} after 120s "
-                    f"(need {args.num_processes}; stale entries from a "
-                    f"killed run are ignored — clear the registry dir)"
+                    f"(need {args.num_processes}{stale_hint})"
                 )
             time.sleep(0.1)
         graph = euler_tpu.Graph(mode="remote", registry=args.registry)

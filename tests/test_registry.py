@@ -1,0 +1,140 @@
+"""TCP shard registry: discovery without a shared filesystem.
+
+Mirrors the reference's ZooKeeper semantics (ephemeral znodes
+"<shard>#<ip:port>", zk_server_register.cc / zk_server_monitor.cc:50-64):
+REG + heartbeat keeps an entry alive, entries of dead shards expire by TTL,
+UNREG removes on clean stop, and a client's LIST sees only live shards.
+"""
+
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph.registry import RegistryServer, parse_tcp_url, query
+from euler_tpu.graph.service import GraphService
+from tests.fixture_graph import write_fixture
+
+
+def _send_frame(sock, payload: bytes) -> bytes:
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+    (n,) = struct.unpack("<I", sock.recv(4, socket.MSG_WAITALL))
+    return sock.recv(n, socket.MSG_WAITALL) if n else b""
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    d = str(tmp_path / "data")
+    import os
+
+    os.makedirs(d)
+    write_fixture(d, num_partitions=2)
+    return d
+
+
+def test_parse_tcp_url():
+    assert parse_tcp_url("tcp://h:91") == ("h", 91)
+    assert parse_tcp_url("/some/dir") is None
+    with pytest.raises(ValueError):
+        parse_tcp_url("tcp://noport")
+
+
+def test_registry_starts_and_lists_empty():
+    with RegistryServer() as reg:
+        assert reg.port > 0
+        assert query(reg.address) == {}
+
+
+def test_query_unreachable_raises():
+    with pytest.raises(ConnectionError):
+        query("tcp://127.0.0.1:1", timeout_ms=200)
+
+
+def test_service_registers_and_unregisters(data_dir):
+    with RegistryServer() as reg:
+        svc = GraphService(data_dir, 0, 1, registry=reg.address)
+        entries = query(reg.address)
+        assert entries == {0: [svc.address]}
+        svc.stop()
+        assert query(reg.address) == {}  # UNREG on clean stop
+
+
+def test_entries_expire_without_heartbeat():
+    """An entry REGed once (no heartbeats) vanishes after the TTL — the
+    ephemeral-znode analog for a SIGKILLed shard."""
+    with RegistryServer(ttl_ms=300) as reg:
+        with socket.create_connection(("127.0.0.1", reg.port), 2) as s:
+            # reply advertises the TTL so registrants can pace heartbeats
+            assert _send_frame(s, b"REG 3 10.0.0.9:7777") == b"OK 300"
+        assert query(reg.address) == {3: ["10.0.0.9:7777"]}
+        time.sleep(0.45)
+        assert query(reg.address) == {}
+
+
+def test_heartbeat_adapts_to_short_ttl(data_dir):
+    """The service paces heartbeats to the TTL the registry returns in
+    the REG reply, so even a sub-second TTL doesn't flap a live shard."""
+    with RegistryServer(ttl_ms=800) as reg:
+        with GraphService(data_dir, 0, 1, registry=reg.address):
+            deadline = time.time() + 2.5  # several TTLs
+            while time.time() < deadline:
+                assert 0 in query(reg.address)
+                time.sleep(0.1)
+
+
+def test_malformed_tcp_url_fails_fast(data_dir):
+    """A tcp:// string without a port must error as a bad URL, not fall
+    through to the flat-file-directory branch."""
+    import euler_tpu
+
+    with pytest.raises(RuntimeError, match="bad tcp registry url"):
+        GraphService(data_dir, 0, 1, registry="tcp://hostonly")
+    with pytest.raises(RuntimeError, match="bad tcp registry url"):
+        euler_tpu.Graph(mode="remote", registry="tcp://hostonly")
+
+
+def test_end_to_end_remote_graph_via_tcp_registry(data_dir):
+    """Shards on two 'hosts' + client discover each other with no shared
+    directory: the multi-host mode the flat-file registry can't do."""
+    import euler_tpu
+
+    with RegistryServer() as reg:
+        with GraphService(data_dir, 0, 2, registry=reg.address), \
+             GraphService(data_dir, 1, 2, registry=reg.address):
+            g = euler_tpu.Graph(mode="remote", registry=reg.address)
+            assert g.num_shards == 2
+            local = euler_tpu.Graph(directory=data_dir)
+            assert g.num_nodes == local.num_nodes
+            ids = g.sample_node(32, -1)
+            assert len(ids) == 32
+            nbr, w, t = g.sample_neighbor(ids, [0, 1], 4)
+            assert nbr.shape == (32, 4)
+            # feature parity through the remote path
+            f_remote = g.get_dense_feature(ids, [0], [2])
+            f_local = local.get_dense_feature(ids, [0], [2])
+            np.testing.assert_allclose(f_remote, f_local)
+            g.close()
+            local.close()
+
+
+def test_run_loop_shared_mode_tcp_registry(data_dir, tmp_path):
+    """run_loop --graph_mode=shared --registry tcp://... : process 0 hosts
+    the registry in-process and trains against its own shard."""
+    from euler_tpu.run_loop import main
+
+    port = RegistryServer(port=0)  # grab a free port number, then release
+    free = port.port
+    port.stop()
+    rc = main([
+        "--data_dir", data_dir, "--model_dir", str(tmp_path / "ck"),
+        "--model", "graphsage_supervised", "--mode", "train",
+        "--graph_mode", "shared", "--registry", f"tcp://127.0.0.1:{free}",
+        "--num_processes", "1", "--num_epochs", "2",
+        "--max_id", "16", "--feature_idx", "0", "--feature_dim", "2",
+        "--label_idx", "2", "--label_dim", "3", "--train_edge_type", "0,1",
+        "--all_edge_type", "0,1", "--fanouts", "3,2", "--dim", "8",
+        "--batch_size", "8", "--log_steps", "2",
+    ])
+    assert rc == 0
