@@ -271,3 +271,244 @@ def build_ppi(out_dir: str, **overrides) -> str:
 
 def build_reddit(out_dir: str, **overrides) -> str:
     return build_synthetic(out_dir, **{**REDDIT, **overrides})
+
+
+# ---------------------------------------------------------------------------
+# Real-dataset preparation (the transform halves of the reference's
+# examples/ppi_data.py:40-150 and reddit_data.py:42-58, minus the network
+# download — zero egress here; point these at data already on disk).
+# Both write meta.json + part_<p>.dat partitions + {train,val,test}.id
+# files ready for `python -m euler_tpu.ppi_main / reddit_main`.
+# ---------------------------------------------------------------------------
+
+
+def _write_graph(out_dir, meta, nodes_iter, id_lists, num_partitions):
+    from euler_tpu.graph.convert import convert_dicts
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    convert_dicts(
+        nodes_iter, meta, os.path.join(out_dir, "part"), num_partitions
+    )
+    # id files hold GRAPH node ids (what evaluate/save_embedding query) —
+    # deliberate deviation from the reference, which writes the source
+    # dataset's id_map values (ppi_data.py:150) and so can't evaluate the
+    # graph it just built unless id_map is the identity.
+    names = ["train.id", "val.id", "test.id"]
+    for name, ids in zip(names, id_lists):
+        with open(os.path.join(out_dir, name), "w") as f:
+            f.writelines("%d\n" % i for i in ids)
+    return out_dir
+
+
+def prepare_ppi(prefix: str, out_dir: str, num_partitions: int = 1,
+                normalize: bool = True) -> str:
+    """GraphSAGE-format PPI on disk -> .dat partitions.
+
+    ``prefix`` as in the GraphSAGE release: reads ``{prefix}-G.json``
+    (node-link), ``{prefix}-feats.npy``, ``{prefix}-id_map.json``,
+    ``{prefix}-class_map.json``. Mirrors reference
+    examples/ppi_data.py:40-175: nodes lacking val/test annotations are
+    dropped; node types are train=0/val=1/test=2; edges touching a
+    val/test endpoint get type 1 ("train_removed"), others type 0;
+    features are standardized by train-split statistics; float_feature
+    slot 0 = the multilabel class vector, slot 1 = features.
+    """
+    with open(prefix + "-G.json") as f:
+        g = json.load(f)
+    feats = np.load(prefix + "-feats.npy").astype(np.float64)
+    with open(prefix + "-id_map.json") as f:
+        id_map = {int(k): int(v) for k, v in json.load(f).items()}
+    with open(prefix + "-class_map.json") as f:
+        class_map = {int(k): v for k, v in json.load(f).items()}
+
+    node_ids = [n["id"] for n in g["nodes"]]
+    attrs = {n["id"]: n for n in g["nodes"]}
+    # node-link "links" reference positions in the "nodes" array
+    # (networkx 1.x node_link_data, what the GraphSAGE release used)
+    adj: dict[int, set] = {i: set() for i in node_ids}
+    for link in g["links"]:
+        s = node_ids[link["source"]]
+        t = node_ids[link["target"]]
+        adj[s].add(t)
+        adj[t].add(s)
+
+    kept = [i for i in node_ids if "val" in attrs[i] and "test" in attrs[i]]
+    kept_set = set(kept)
+
+    def ntype(i):
+        return 1 if attrs[i]["val"] else (2 if attrs[i]["test"] else 0)
+
+    if normalize:
+        train_rows = np.array(
+            [id_map[i] for i in kept if ntype(i) == 0], dtype=np.int64
+        )
+        mean = feats[train_rows].mean(axis=0)
+        std = feats[train_rows].std(axis=0)
+        std[std == 0] = 1.0
+        feats = (feats - mean) / std
+
+    meta = {
+        "node_type_num": 3,
+        "edge_type_num": 2,
+        "node_uint64_feature_num": 0,
+        "node_float_feature_num": 2,  # 0 labels, 1 features
+        "node_binary_feature_num": 0,
+        "edge_uint64_feature_num": 0,
+        "edge_float_feature_num": 0,
+        "edge_binary_feature_num": 0,
+    }
+
+    def etype(a, b):
+        # "train_removed": either endpoint is outside the train split
+        return 1 if (ntype(a) or ntype(b)) else 0
+
+    def nodes_iter():
+        for i in kept:
+            nbrs = [n for n in adj[i] if n in kept_set]
+            labels = class_map[i]
+            labels = (
+                [float(x) for x in labels]
+                if isinstance(labels, list)
+                else [float(labels)]
+            )
+            yield {
+                "node_id": i,
+                "node_type": ntype(i),
+                "node_weight": 1,
+                "neighbor": {
+                    str(t): {
+                        str(n): 1 for n in nbrs if etype(i, n) == t
+                    }
+                    for t in range(2)
+                },
+                "uint64_feature": {},
+                "float_feature": {
+                    "0": labels,
+                    "1": feats[id_map[i]].tolist(),
+                },
+                "binary_feature": {},
+                "edge": [
+                    {
+                        "src_id": i,
+                        "dst_id": n,
+                        "edge_type": etype(i, n),
+                        "weight": 1,
+                        "uint64_feature": {},
+                        "float_feature": {},
+                        "binary_feature": {},
+                    }
+                    for n in nbrs
+                ],
+            }
+
+    ids = [[i for i in kept if ntype(i) == t] for t in range(3)]
+    return _write_graph(out_dir, meta, nodes_iter(), ids, num_partitions)
+
+
+def prepare_reddit(data_dir: str, out_dir: str,
+                   num_partitions: int = 1) -> str:
+    """DGL reddit npz files on disk -> .dat partitions.
+
+    Reads ``{data_dir}/reddit_self_loop_graph.npz`` (scipy CSR adjacency)
+    and ``{data_dir}/reddit_data.npz`` (feature / node_ids / label /
+    node_types). Mirrors reference examples/reddit_data.py:42-135: node
+    type = node_types - 1 (train=0/val=1/test=2), all edges type 0,
+    float_feature slot 0 = one-hot(label, 41), slot 1 = features.
+    """
+    import scipy.sparse as sp
+
+    graph = sp.load_npz(
+        os.path.join(data_dir, "reddit_self_loop_graph.npz")
+    ).tocsr()
+    data = np.load(os.path.join(data_dir, "reddit_data.npz"))
+    feats = data["feature"]
+    id_map = data["node_ids"].astype(np.int64)
+    labels = data["label"].astype(np.int64)
+    node_types = data["node_types"].astype(np.int64)
+    num_nodes = graph.shape[0]
+    num_classes = int(labels.max()) + 1
+
+    meta = {
+        "node_type_num": 3,
+        "edge_type_num": 1,
+        "node_uint64_feature_num": 0,
+        "node_float_feature_num": 2,  # 0 labels, 1 features
+        "node_binary_feature_num": 0,
+        "edge_uint64_feature_num": 0,
+        "edge_float_feature_num": 0,
+        "edge_binary_feature_num": 0,
+    }
+
+    def nodes_iter():
+        indptr, indices = graph.indptr, graph.indices
+        for i in range(num_nodes):
+            nbrs = indices[indptr[i]:indptr[i + 1]]
+            onehot = [0.0] * num_classes
+            onehot[int(labels[i])] = 1.0
+            yield {
+                "node_id": i,
+                "node_type": int(node_types[i]) - 1,
+                "node_weight": 1,
+                "neighbor": {"0": {str(int(n)): 1 for n in nbrs}},
+                "uint64_feature": {},
+                "float_feature": {
+                    "0": onehot,
+                    "1": feats[i].tolist(),
+                },
+                "binary_feature": {},
+                "edge": [
+                    {
+                        "src_id": i,
+                        "dst_id": int(n),
+                        "edge_type": 0,
+                        "weight": 1,
+                        "uint64_feature": {},
+                        "float_feature": {},
+                        "binary_feature": {},
+                    }
+                    for n in nbrs
+                ],
+            }
+
+    ids = [
+        [i for i in range(num_nodes) if node_types[i] - 1 == t]
+        for t in range(3)
+    ]
+    return _write_graph(out_dir, meta, nodes_iter(), ids, num_partitions)
+
+
+def main() -> None:
+    """CLI: synthetic builders + real-data preparation.
+
+    python -m euler_tpu.datasets ppi|reddit --out DIR          (synthetic)
+    python -m euler_tpu.datasets prepare_ppi --prefix P --out DIR
+    python -m euler_tpu.datasets prepare_reddit --src DIR --out DIR
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("cmd", choices=[
+        "ppi", "reddit", "prepare_ppi", "prepare_reddit"])
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--prefix", help="GraphSAGE file prefix (prepare_ppi)")
+    ap.add_argument("--src", help="DGL npz directory (prepare_reddit)")
+    ap.add_argument("--partitions", type=int, default=1)
+    args = ap.parse_args()
+    if args.cmd == "ppi":
+        print(build_ppi(args.out, num_partitions=args.partitions))
+    elif args.cmd == "reddit":
+        print(build_reddit(args.out, num_partitions=args.partitions))
+    elif args.cmd == "prepare_ppi":
+        if not args.prefix:
+            ap.error("prepare_ppi needs --prefix")
+        print(prepare_ppi(args.prefix, args.out, args.partitions))
+    else:
+        if not args.src:
+            ap.error("prepare_reddit needs --src")
+        print(prepare_reddit(args.src, args.out, args.partitions))
+
+
+if __name__ == "__main__":
+    main()

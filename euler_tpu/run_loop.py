@@ -59,6 +59,10 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--graph_mode", default="local",
                    choices=["local", "remote", "shared"])
     p.add_argument("--registry", default="")
+    p.add_argument("--service_host", default="", help=(
+        "address this process's graph shard binds and advertises "
+        "(shared mode). Empty = auto: the interface that routes to a "
+        "tcp:// registry host, else 127.0.0.1"))
     p.add_argument("--shards", default="",
                    help="comma list of host:port (remote mode)")
     p.add_argument("--train_node_type", type=int, default=0)
@@ -170,11 +174,28 @@ def build_graph(args):
                                 f"{host}?)"
                             )
                         time.sleep(0.2)
+        # The shard must advertise an address other hosts can dial: with a
+        # remote tcp:// registry, default to the local interface that
+        # routes toward the registry host (the reference's GetIP analog,
+        # euler/common/net_util.cc:32); loopback only for single-host runs.
+        service_host = args.service_host
+        if not service_host:
+            service_host = "127.0.0.1"
+            if tcp_registry and host not in ("127.0.0.1", "localhost"):
+                import socket as _socket
+
+                probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                try:
+                    probe.connect((host, 9))  # no traffic; routing only
+                    service_host = probe.getsockname()[0]
+                finally:
+                    probe.close()
         services.append(
             euler_tpu.GraphService(
                 args.data_dir,
                 shard_idx=args.process_id,
                 shard_num=args.num_processes,
+                host=service_host,
                 registry=args.registry,
             )
         )
