@@ -131,17 +131,8 @@ class Console:
 
         if not args:
             return _help(["con"])
-        conf = {}
-        for kv in args[0].split(";"):
-            if "=" not in kv:
-                continue
-            k, v = kv.split("=", 1)
-            try:  # numeric params (shard_idx, shard_num, ...) arrive typed
-                conf[k] = int(v)
-            except ValueError:
-                conf[k] = v
-        mode = conf.pop("mode", "local")
-        self.graph = euler_tpu.Graph(mode=mode, **conf)
+        # same loader as Graph(config=...): inline k=v;k=v or an .ini path
+        self.graph = euler_tpu.Graph(config=args[0])
         print(
             f"connected: {self.graph.num_nodes} nodes, "
             f"{self.graph.num_edges} edges, "

@@ -13,6 +13,7 @@ the bit patterns pass through unchanged (default ids like -1 wrap).
 from __future__ import annotations
 
 import ctypes
+import threading
 
 import numpy as np
 
@@ -46,35 +47,154 @@ def _default_u64(default_node: int) -> int:
     return int(np.int64(default_node).view(np.uint64))
 
 
+def parse_config(source: str) -> dict:
+    """Parse a client config: a ``.ini``-style file of ``key = value``
+    lines ('#'/';' comments, optional [sections] ignored) or an inline
+    ``k=v;k=v`` string. Values that look numeric come back as ints.
+
+    Role equivalent of the reference's GraphConfig loader
+    (reference euler/client/graph_config.cc:33-56) plus the semicolon
+    string form used across its C ABI (create_graph.cc:50-60).
+    """
+    import os
+
+    # a path wins over the inline form when both could apply (paths may
+    # legitimately contain '='; inline strings are never existing files)
+    if os.path.exists(source) or "=" not in source:
+        with open(source) as f:
+            lines = f.read().splitlines()
+    else:
+        lines = source.split(";")
+    out: dict = {}
+    for line in lines:
+        line = line.strip()
+        if not line or line[0] in "#;[":
+            continue
+        if "=" not in line:
+            raise ValueError(f"bad config line (want key=value): {line!r}")
+        k, v = (s.strip() for s in line.split("=", 1))
+        try:
+            out[k] = int(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
 class Graph:
     """Graph client: embedded engine (mode='local') or sharded remote
     client (mode='remote').
 
     Mode selection mirrors the reference factory Graph::NewGraph
     (reference euler/client/graph.cc:157-185): local embeds the engine
-    in-process; remote discovers shards from a flat-file ``registry``
-    directory (written by :class:`euler_tpu.graph.GraphService`) or an
+    in-process; remote discovers shards from a ``registry`` (flat-file
+    directory written by :class:`euler_tpu.graph.GraphService`, or
+    ``tcp://host:port`` of a euler_tpu.graph.registry server) or an
     explicit ``shards`` list, routes ids shard(id) = (id % P) % S, and
     merges scatter/gather replies — all in native code (eg_remote.cc).
+
+    Like the reference, the client also takes a config file or inline
+    config string (``config=``: ``key = value`` lines or ``k=v;k=v``,
+    graph_config.cc:33-56) with explicit kwargs taking precedence, and
+    ``init="lazy"`` defers engine construction to first use
+    (graph.cc:176-183).
     """
 
     def __init__(
         self,
         directory: str | None = None,
         files: list[str] | None = None,
-        shard_idx: int = 0,
-        shard_num: int = 1,
-        mode: str = "local",
+        shard_idx: int | None = None,
+        shard_num: int | None = None,
+        mode: str | None = None,
         registry: str | None = None,
         shards: list[str] | list[list[str]] | None = None,
-        retries: int = 3,
-        timeout_ms: int = 5000,
-        quarantine_ms: int = 3000,
+        retries: int | None = None,
+        timeout_ms: int | None = None,
+        quarantine_ms: int | None = None,
         cache_dir: str | None = None,
+        config: str | None = None,
+        init: str | None = None,
     ):
         self._lib = lib()
+        self._handle = None
+        self._closed = False
+        self._connect_lock = threading.Lock()
+        # config file / inline string (reference Graph::NewGraph(filename),
+        # euler/client/graph.cc:163-185); explicit kwargs override it
+        cfg = parse_config(config) if config else {}
+        known = {
+            "directory", "files", "shard_idx", "shard_num", "mode",
+            "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
+            "cache_dir", "init",
+        }
+        unknown = set(cfg) - known
+        if unknown:
+            # only a fixed key set is consumed — a typo'd key would
+            # otherwise be dropped silently (e.g. timout_ms)
+            raise ValueError(
+                f"unknown config keys {sorted(unknown)}; valid: "
+                f"{sorted(known)}"
+            )
+
+        def pick(name, explicit, default):
+            return explicit if explicit is not None else cfg.get(name, default)
+
+        directory = pick("directory", directory, None)
+        files = pick("files", files, None)
+        if isinstance(files, str):
+            files = [s.strip() for s in files.split(",")]
+        shard_idx = int(pick("shard_idx", shard_idx, 0))
+        shard_num = int(pick("shard_num", shard_num, 1))
+        mode = str(pick("mode", mode, "local")).lower()
+        registry = pick("registry", registry, None)
+        shards = pick("shards", shards, None)
+        if isinstance(shards, str):
+            shards = [s.strip() for s in shards.split(",")]
+        retries = int(pick("retries", retries, 3))
+        timeout_ms = int(pick("timeout_ms", timeout_ms, 5000))
+        quarantine_ms = int(pick("quarantine_ms", quarantine_ms, 3000))
+        cache_dir = pick("cache_dir", cache_dir, None)
+        init = str(pick("init", init, "eager")).lower()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
+        if init not in ("eager", "lazy"):
+            raise ValueError("init must be 'eager' or 'lazy'")
+        self._params = dict(
+            directory=directory, files=files, shard_idx=shard_idx,
+            shard_num=shard_num, registry=registry, shards=shards,
+            retries=retries, timeout_ms=timeout_ms,
+            quarantine_ms=quarantine_ms, cache_dir=cache_dir,
+        )
+        self.mode = mode
+        if init == "eager":
+            self._connect()
+
+    @property
+    def _h(self):
+        """Native handle; a lazy-init graph connects on first use
+        (reference init=lazy, graph.cc:176-183). Thread-safe: concurrent
+        first users (prefetch workers) connect exactly once."""
+        if self._handle is None:
+            with self._connect_lock:
+                if self._handle is None:
+                    self._connect()
+        return self._handle
+
+    def _connect(self) -> None:
+        if self._closed:
+            # close() must be final: a lingering reference (say a prefetch
+            # thread) must not silently re-load the store or re-dial the
+            # cluster through the lazy property
+            raise RuntimeError("graph is closed")
+        p = self._params
+        directory = p["directory"]
+        files = p["files"]
+        shard_idx, shard_num = p["shard_idx"], p["shard_num"]
+        registry, shards = p["registry"], p["shards"]
+        cache_dir = p["cache_dir"]
+        retries = p["retries"]
+        timeout_ms, quarantine_ms = p["timeout_ms"], p["quarantine_ms"]
+        mode = self.mode
         # Remote filesystems (the reference reads graph data straight off
         # HDFS, euler/common/hdfs_file_io.cc:79-80): any fsspec URL is
         # staged shard-aware to a local cache, then loaded through the one
@@ -110,7 +230,6 @@ class Graph:
                 "euler_tpu.graph.registry server, or an explicit "
                 "shards= list"
             )
-        self.mode = mode
         if mode == "remote":
             if registry:
                 conf = f"registry={registry}"
@@ -126,26 +245,28 @@ class Graph:
                 f";retries={retries};timeout_ms={timeout_ms}"
                 f";quarantine_ms={quarantine_ms}"
             )
-            self._h = self._lib.eg_remote_create(conf.encode())
-            if not self._h:
+            self._handle = self._lib.eg_remote_create(conf.encode())
+            if not self._handle:
+                self._handle = None
                 err = self._lib.eg_last_error().decode()
                 raise RuntimeError(f"remote graph init failed: {err}")
             return
-        self._h = self._lib.eg_create()
+        h = self._lib.eg_create()
         if directory is not None:
             rc = self._lib.eg_load(
-                self._h, directory.encode(), shard_idx, shard_num
+                h, directory.encode(), shard_idx, shard_num
             )
         elif files:
             arr = (ctypes.c_char_p * len(files))(*[f.encode() for f in files])
-            rc = self._lib.eg_load_files(self._h, arr, len(files))
+            rc = self._lib.eg_load_files(h, arr, len(files))
         else:
+            self._lib.eg_destroy(h)
             raise ValueError("pass directory= or files=")
         if rc != 0:
             err = self._lib.eg_last_error().decode()
-            self._lib.eg_destroy(self._h)
-            self._h = None
+            self._lib.eg_destroy(h)
             raise RuntimeError(f"graph load failed: {err}")
+        self._handle = h
 
     @property
     def num_shards(self) -> int:
@@ -162,9 +283,11 @@ class Graph:
         )
 
     def close(self) -> None:
-        if getattr(self, "_h", None):
-            self._lib.eg_destroy(self._h)
-            self._h = None
+        # touch _handle, not _h: closing a lazy graph must not connect it
+        self._closed = True
+        if getattr(self, "_handle", None):
+            self._lib.eg_destroy(self._handle)
+            self._handle = None
 
     def __del__(self):
         try:

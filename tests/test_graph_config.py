@@ -1,0 +1,150 @@
+"""Client config-file / inline-config / lazy-init surface
+(reference euler/client/graph.cc:163-185 NewGraph(config_file) +
+graph_config.cc:33-56 key=value loader + init=lazy)."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph.graph import Graph, parse_config
+
+
+def test_parse_inline_string():
+    cfg = parse_config("mode=local;shard_idx=1;directory=/d;x = 7")
+    assert cfg == {"mode": "local", "shard_idx": 1, "directory": "/d",
+                   "x": 7}
+
+
+def test_parse_ini_file(tmp_path):
+    p = tmp_path / "g.ini"
+    p.write_text(
+        "# euler client config\n"
+        "[graph]\n"
+        "mode = local\n"
+        "directory = /data/g\n"
+        "shard_num = 4\n"
+        "; trailing comment\n"
+    )
+    cfg = parse_config(str(p))
+    assert cfg == {"mode": "local", "directory": "/data/g", "shard_num": 4}
+
+
+def test_parse_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.ini"
+    p.write_text("not a key value line\n")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_config(str(p))
+
+
+def test_graph_from_config_file(fixture_dir, tmp_path):
+    p = tmp_path / "g.ini"
+    p.write_text(f"mode = local\ndirectory = {fixture_dir}\n")
+    g = Graph(config=str(p))
+    assert g.num_nodes == 7
+    g.close()
+
+
+def test_kwargs_override_config(fixture_dir, tmp_path):
+    p = tmp_path / "g.ini"
+    p.write_text("mode = local\ndirectory = /nonexistent\n")
+    g = Graph(config=str(p), directory=fixture_dir)
+    assert g.num_nodes == 7
+    g.close()
+
+
+def test_lazy_init_defers_load(fixture_dir):
+    g = Graph(directory=fixture_dir, init="lazy")
+    assert g._handle is None  # nothing loaded yet
+    ids = g.sample_node(4, -1)  # first use connects
+    assert len(ids) == 4
+    assert g._handle is not None
+    g.close()
+
+
+def test_lazy_init_close_without_use(fixture_dir):
+    g = Graph(directory=fixture_dir, init="lazy")
+    g.close()  # must not connect just to close
+    assert g._handle is None
+
+
+def test_lazy_init_from_config_string(fixture_dir):
+    g = Graph(config=f"directory={fixture_dir};init=lazy")
+    assert g._handle is None
+    assert g.num_edges > 0
+    g.close()
+
+
+def test_lazy_init_error_surfaces_on_first_use(tmp_path):
+    g = Graph(directory=str(tmp_path / "empty"), init="lazy")
+    with pytest.raises(RuntimeError, match="load failed"):
+        g.sample_node(1, -1)
+
+
+def test_bad_init_value(fixture_dir):
+    with pytest.raises(ValueError, match="eager.*lazy|lazy.*eager"):
+        Graph(directory=fixture_dir, init="sometimes")
+
+
+def test_mode_case_insensitive(fixture_dir):
+    # the reference writes Local/Remote capitalized in configs
+    g = Graph(config=f"mode=Local;directory={fixture_dir}")
+    assert g.num_nodes == 7
+    g.close()
+
+
+def test_unknown_config_key_rejected(fixture_dir):
+    with pytest.raises(ValueError, match="timout_ms"):
+        Graph(config=f"directory={fixture_dir};timout_ms=20000")
+
+
+def test_config_path_containing_equals(tmp_path, fixture_dir):
+    d = tmp_path / "run=3"
+    d.mkdir()
+    p = d / "g.ini"
+    p.write_text(f"directory = {fixture_dir}\n")
+    g = Graph(config=str(p))  # existing path wins over inline parse
+    assert g.num_nodes == 7
+    g.close()
+
+
+def test_config_list_values_strip_spaces(fixture_dir):
+    import os
+
+    files = ", ".join(
+        os.path.join(fixture_dir, f)
+        for f in sorted(os.listdir(fixture_dir))
+        if f.endswith(".dat")
+    )
+    g = Graph(config=f"files={files}")
+    assert g.num_nodes == 7
+    g.close()
+
+
+def test_use_after_close_raises(fixture_dir):
+    g = Graph(directory=fixture_dir)
+    g.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        g.sample_node(1, -1)
+
+
+def test_lazy_concurrent_first_use_connects_once(fixture_dir):
+    import threading
+
+    g = Graph(directory=fixture_dir, init="lazy")
+    connects = []
+    real = g._connect
+
+    def counting():
+        connects.append(1)
+        real()
+
+    g._connect = counting
+    threads = [
+        threading.Thread(target=lambda: g.sample_node(4, -1))
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert connects == [1]
+    g.close()
