@@ -235,6 +235,62 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
     )
     sps = measure / dt
     edges_per_sec = edges_per_step * sps / n_chips
+
+    # Device-sampling path: adjacency in HBM, roots + fanout sampled
+    # inside the jitted step, lax.scan chaining CHUNK steps per dispatch
+    # (euler_tpu/graph/device.py + train.make_scan_train). This is the
+    # framework's intended fast path for graphs that fit in HBM; the
+    # host-path numbers above remain in the breakdown for comparison.
+    ds = {}
+    try:
+        model_ds = SupervisedGraphSage(
+            label_idx=0,
+            label_dim=cfg["label_dim"],
+            metapath=[[0]] * len(fanouts),
+            fanouts=fanouts,
+            dim=dim,
+            feature_idx=1,
+            feature_dim=cfg["feature_dim"],
+            max_id=cfg["num_nodes"] - 1,
+            device_features=True,
+            device_sampling=True,
+        )
+        t_up = time.perf_counter()
+        state_ds = model_ds.init_state(
+            jax.random.PRNGKey(0), graph,
+            graph.sample_node(batch_size, -1), opt,
+        )
+        state_ds = jax.device_put(state_ds, rep)
+        chunk_steps = 50
+        scan = jax.jit(
+            train_lib.make_scan_train(
+                model_ds, opt, chunk_steps, batch_size
+            ),
+            donate_argnums=(0,),
+        )
+        state_ds, l0 = scan(state_ds, 0)  # compile + warmup chunk
+        jax.block_until_ready(l0)
+        upload_s = time.perf_counter() - t_up
+        chunks = 2 if platform == "cpu" else 10
+        t2 = time.perf_counter()
+        last = None
+        for c in range(1, chunks + 1):
+            state_ds, last = scan(state_ds, c)
+        jax.block_until_ready(last)
+        ds_dt = time.perf_counter() - t2
+        ds_sps = chunks * chunk_steps / ds_dt
+        ds["steps_per_sec"] = round(ds_sps, 2)
+        ds["edges_per_sec"] = round(edges_per_step * ds_sps / n_chips, 1)
+        ds["step_wall_ms"] = round(ds_dt / (chunks * chunk_steps) * 1e3, 4)
+        ds["setup_s"] = round(upload_s, 2)
+        ds["final_loss"] = round(float(np.asarray(last)[-1]), 4)
+        del state_ds
+    except Exception as e:  # never lose the host-path number
+        ds["error"] = f"{type(e).__name__}: {e}"[:300]
+
+    if ds.get("edges_per_sec", 0) > edges_per_sec:
+        edges_per_sec = ds["edges_per_sec"]
+        sps = ds["steps_per_sec"]
     return {
         "metric": f"{name}_edges/sec/chip" if name != "ppi" else "edges/sec/chip",
         "value": round(edges_per_sec, 1),
@@ -249,6 +305,10 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
             "chips": n_chips,
             "platform": platform,
             "final_loss": round(float(np.asarray(losses[-1])), 4),
+            "device_sampling": ds,
+            "host_path_edges_per_sec": round(
+                edges_per_step * (measure / dt) / n_chips, 1
+            ),
             "breakdown": {
                 "host_sample_ms_per_batch": round(host_sample_ms, 2),
                 "device_step_ms": round(device_step_ms, 2),

@@ -173,6 +173,12 @@ void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
   API(h)->SampleNodeWithSrc(src, n, count, out);
 }
 
+// Engine-only (local mode; the Python layer guards the mode): per-node
+// sampling weights for the device-graph exporter.
+void eg_get_node_weight(void* h, const uint64_t* ids, int n, float* out) {
+  Local(h)->GetNodeWeight(ids, n, out);
+}
+
 void eg_get_node_type(void* h, const uint64_t* ids, int n, int32_t* out) {
   eg::SpanTimer span(eg::kStatNodeType);
   API(h)->GetNodeType(ids, n, out);

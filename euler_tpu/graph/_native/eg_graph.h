@@ -106,6 +106,7 @@ class GraphStore {
     return it == edge_idx_.end() ? -1 : it->second;
   }
   inline int32_t NodeTypeAt(int64_t idx) const { return node_types_[idx]; }
+  inline float NodeWeightAt(int64_t idx) const { return node_weights_[idx]; }
   uint64_t NodeIdAt(int64_t idx) const { return node_ids_[idx]; }
 
   // ---- global sampling (weight-proportional) ----

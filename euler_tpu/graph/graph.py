@@ -357,6 +357,22 @@ class Graph:
         )
         return out
 
+    def node_weights(self, ids) -> np.ndarray:
+        """Per-node sampling weights (0 for unknown ids). Local mode only:
+        feeds the device-graph exporter, which needs the whole graph
+        in-process anyway."""
+        if self.mode != "local":
+            raise NotImplementedError(
+                "node_weights is local-mode only (device-graph export "
+                "needs the embedded engine)"
+            )
+        ids = _ids(ids)
+        out = np.empty(len(ids), dtype=np.float32)
+        self._lib.eg_get_node_weight(
+            self._h, _ptr(ids, _U64P), len(ids), _ptr(out, _F32P)
+        )
+        return out
+
     # ---- neighbor ops ----
     def sample_neighbor(
         self, ids, edge_types, count: int, default_node: int = -1

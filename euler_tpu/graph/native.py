@@ -85,6 +85,7 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_sample_edge, None, [p, c.c_int, c.c_int32, u64p, u64p, i32p])
     _sig(L.eg_sample_node_with_src, None, [p, u64p, c.c_int, c.c_int, u64p])
     _sig(L.eg_get_node_type, None, [p, u64p, c.c_int, i32p])
+    _sig(L.eg_get_node_weight, None, [p, u64p, c.c_int, f32p])
     _sig(
         L.eg_sample_neighbor,
         None,

@@ -8,6 +8,7 @@ aggregation pyramid + decoder.
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional, Sequence
 
 import flax.linen as nn
@@ -35,6 +36,8 @@ class _SupervisedSageModule(nn.Module):
     max_id: int = -1
     embedding_dim: int = 16
     sparse_feature_max_ids: Sequence[int] = ()
+    # device-sampling mode: per-hop keys into consts["adj"]
+    hop_adj_keys: Sequence[str] = ()
 
     def setup(self):
         self.node_encoder = ShallowEncoder(
@@ -48,17 +51,38 @@ class _SupervisedSageModule(nn.Module):
         )
         self.predict = nn.Dense(self.num_classes)
 
-    def embed(self, batch, consts=None):
+    def _hops(self, batch, consts):
+        """Training inputs per hop: host-sampled ("hops") or sampled HERE
+        on device from the HBM-resident adjacency ("roots" + "seed")."""
+        if "hops" in batch:
+            return batch["hops"]
+        import jax
+
+        from euler_tpu.graph import device as device_graph
+
+        key = jax.random.PRNGKey(batch["seed"][0])
+        adjs = [consts["adj"][k] for k in self.hop_adj_keys]
+        ids = device_graph.sample_fanout(
+            adjs, batch["roots"], key, list(self.fanouts)
+        )
+        if self.max_id >= 0:  # use_id: the gids double as embedding ids
+            return [{"gids": i, "ids": i} for i in ids]
+        return [{"gids": i} for i in ids]
+
+    def _embed_hops(self, hops, consts):
         hidden = [
-            self.node_encoder(base.gather_consts(f, consts))
-            for f in batch["hops"]
+            self.node_encoder(base.gather_consts(f, consts)) for f in hops
         ]
         return self.encoder(hidden)
 
+    def embed(self, batch, consts=None):
+        return self._embed_hops(self._hops(batch, consts), consts)
+
     def __call__(self, batch, consts=None):
-        embedding = self.embed(batch, consts)
+        hops = self._hops(batch, consts)
+        embedding = self._embed_hops(hops, consts)
         logits = self.predict(embedding)
-        labels = base.lookup_labels(batch, consts, batch["hops"][0].get("gids"))
+        labels = base.lookup_labels(batch, consts, hops[0].get("gids"))
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -96,11 +120,28 @@ class SupervisedGraphSage(base.Model):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        device_sampling: bool = False,
+        train_node_type: int = -1,
     ):
         super().__init__()
+        self.train_node_type = train_node_type
+        if device_sampling and not device_features:
+            raise ValueError(
+                "device_sampling=True requires device_features=True "
+                "(the sampled ids are consumed by on-device gathers)"
+            )
+        if device_sampling and sparse_feature_idx:
+            raise ValueError(
+                "device_sampling does not support sparse features (no "
+                "device-resident sparse table); use the host path"
+            )
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
+        self.device_sampling = device_sampling and self.device_features
+        # itertools.count: sample() runs in concurrent prefetch workers and
+        # next() is atomic, where += would race and duplicate seeds
+        self._sample_seed = itertools.count(1)
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.metapath = [list(m) for m in metapath]
@@ -113,6 +154,11 @@ class SupervisedGraphSage(base.Model):
         self.sparse_feature_max_ids = list(sparse_feature_max_ids)
         self.sparse_max_len = sparse_max_len
         self.default_node = max_id + 1 if max_id >= 0 else -1
+        # device-sampling: one adjacency slab per distinct hop type-set,
+        # hops referencing the same set share one upload
+        self._hop_adj_keys = [
+            "et" + "_".join(map(str, m)) for m in self.metapath
+        ]
         self.module = _SupervisedSageModule(
             fanouts=tuple(fanouts),
             dim=dim,
@@ -124,10 +170,43 @@ class SupervisedGraphSage(base.Model):
             max_id=max_id if use_id else -1,
             embedding_dim=embedding_dim,
             sparse_feature_max_ids=tuple(sparse_feature_max_ids),
+            hop_adj_keys=tuple(self._hop_adj_keys),
         )
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            from euler_tpu.graph import device as device_graph
+
+            adj = {}
+            for key, et in zip(self._hop_adj_keys, self.metapath):
+                if key not in adj:
+                    adj[key] = device_graph.build_adjacency(
+                        graph, et, self.max_id
+                    )
+            consts["adj"] = adj
+            # weighted root sampler for the fully-device scanned loop
+            # (train.make_scan_train); harmless extra [N] arrays otherwise
+            consts["roots"] = device_graph.build_node_sampler(
+                graph, self.train_node_type, self.max_id
+            )
+        return consts
 
     def sample(self, graph, inputs) -> dict:
         inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            # the fanout happens inside the jitted step; host ships only
+            # root ids + a per-batch seed for the device RNG
+            return {
+                "roots": np.clip(inputs, 0, self.max_id + 1).astype(
+                    np.int32
+                ),
+                # [B] so it shards like the rest of the batch; the module
+                # reads element 0 (all equal)
+                "seed": np.full(
+                    len(inputs), next(self._sample_seed), np.int32
+                ),
+            }
         ids_per_hop, _, _ = graph.sample_fanout(
             inputs, self.metapath, self.fanouts, self.default_node
         )

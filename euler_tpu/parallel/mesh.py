@@ -113,10 +113,20 @@ def _model_axis_size(mesh: Mesh) -> int:
 
 def _is_table(path, x) -> bool:
     """True for leaves under a _TABLE_KEYS top-level state key — the
-    per-node tables that row-shard (and row-pad) over the model axis."""
+    per-node tables that row-shard (and row-pad) over the model axis.
+    Device-sampling structures (consts['adj'] / consts['roots']) are
+    excluded: their cumulative-weight arrays must stay contiguous and
+    unpadded (zero-padding would unsort the searchsorted input), so they
+    replicate."""
     key = path[0]
     name = getattr(key, "key", getattr(key, "idx", None))
-    return name in _TABLE_KEYS and np.ndim(x) >= 1
+    if name not in _TABLE_KEYS or np.ndim(x) < 1:
+        return False
+    if name == "consts" and len(path) > 1:
+        sub = getattr(path[1], "key", getattr(path[1], "idx", None))
+        if sub in ("adj", "roots"):
+            return False
+    return True
 
 
 def state_sharding(mesh: Mesh, state):
@@ -155,6 +165,13 @@ def pad_tables_for_mesh(state, mesh: Mesh):
 
 
 def shard_batch(batch, mesh: Mesh):
-    """Place a host batch pytree onto the mesh, leading dim sharded."""
+    """Place a host batch pytree onto the mesh, leading dim sharded
+    (scalars — e.g. a device-sampling seed — are replicated)."""
     sharding = batch_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    rep = replicated_sharding(mesh)
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            x, rep if np.ndim(x) == 0 else sharding
+        ),
+        batch,
+    )
