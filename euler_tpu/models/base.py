@@ -165,6 +165,34 @@ class Model:
     def sample_embed(self, graph, inputs) -> dict:
         return self.sample(graph, inputs)
 
+    # ---- device-resident sampling (euler_tpu/graph/device.py) ----
+    def init_device_sampling(self, device_sampling: bool) -> None:
+        """Resolve the device_sampling flag (call AFTER device_features is
+        resolved) and set up the per-batch seed counter."""
+        import itertools
+
+        if device_sampling and not self.device_features:
+            raise ValueError(
+                "device_sampling=True requires device_features=True "
+                "(the sampled ids are consumed by on-device gathers)"
+            )
+        self.device_sampling = device_sampling and self.device_features
+        # itertools.count: sample() runs in concurrent prefetch workers
+        # and next() is atomic, where += would race and duplicate seeds
+        self._sample_seed = itertools.count(1)
+
+    def device_sample_batch(self, inputs) -> dict:
+        """The whole per-step host payload in device-sampling mode: root
+        ids + a per-batch RNG seed ([B] so it shards like the rest; the
+        module reads element 0 — all equal)."""
+        roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        return {
+            "roots": np.clip(roots, 0, self.max_id + 1).astype(np.int32),
+            "seed": np.full(
+                len(roots), next(self._sample_seed), np.int32
+            ),
+        }
+
     def node_inputs(self, graph, ids: np.ndarray) -> dict:
         """Shared host-side gather of one node set's ShallowEncoder inputs,
         driven by the model's configured feature attributes (use_id /
@@ -310,11 +338,14 @@ class ScalableStoreModel(Model):
 
     def init_state(self, rng, graph, example_inputs, optimizer) -> dict:
         batch = self.sample(graph, example_inputs)
+        consts = self.build_consts(graph) or None
+        # a device-sampling batch (roots + seed) expands here eagerly so
+        # the module init sees the node_ids/neigh_ids layout
+        batch = self._expand_batch(batch, consts)
         store_reads = [
             jnp.zeros((len(batch["neigh_ids"]), self.dim))
             for _ in range(self.num_layers - 1)
         ]
-        consts = self.build_consts(graph) or None
         # Scalable modules all take consts=None, so pass it positionally.
         variables = self.module.init(rng, batch, store_reads, consts)
         params = variables["params"]
@@ -350,9 +381,10 @@ class ScalableStoreModel(Model):
         num_stores = self.num_layers - 1
 
         def train_step(state, batch):
+            consts = state.get("consts")  # None when not device_features
+            batch = self._expand_batch(batch, consts)
             node_ids = batch["node_ids"]
             neigh_ids = batch["neigh_ids"]
-            consts = state.get("consts")  # None when not device_features
             store_reads = [s[neigh_ids] for s in state["stores"]]
             stale = [gs[node_ids] for gs in state["grad_stores"]]
             grad_stores = [
@@ -423,7 +455,13 @@ class ScalableStoreModel(Model):
 
         return train_step
 
+    def _expand_batch(self, batch, consts):
+        """Hook: turn a device-sampling batch (roots + seed) into the
+        node_ids/neigh_ids layout inside jit. Default: pass through."""
+        return batch
+
     def _apply_with_stores(self, state, batch):
+        batch = self._expand_batch(batch, state.get("consts"))
         store_reads = [s[batch["neigh_ids"]] for s in state["stores"]]
         return self.module.apply(
             {"params": state["params"]},

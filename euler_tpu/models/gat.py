@@ -22,6 +22,8 @@ class _GATModule(nn.Module):
     hidden_dim: int
     num_classes: int
     sigmoid_loss: bool = True
+    nb_num: int = 5
+    adj_key: str = ""
 
     def setup(self):
         self.encoder = AttEncoder(
@@ -30,18 +32,39 @@ class _GATModule(nn.Module):
             out_dim=self.num_classes,
         )
 
+    def _seq_ids(self, batch, consts):
+        if "seq_ids" in batch:
+            return batch["seq_ids"]
+        # device sampling: draw the nb_num attention neighbors here
+        import jax
+        import jax.numpy as jnp
+
+        from euler_tpu.graph import device as device_graph
+
+        roots = batch["roots"]
+        key = jax.random.PRNGKey(batch["seed"][0])
+        nbrs = device_graph.sample_neighbor(
+            consts["adj"][self.adj_key], roots, key, self.nb_num
+        )
+        return jnp.concatenate([roots[:, None], nbrs], axis=1)
+
     def embed(self, batch, consts=None):
         if "seq" in batch:
             return self.encoder(batch["seq"])
         # device-resident features: gather [B, nb+1, fdim] from the table
-        return self.encoder(consts["features"][batch["seq_ids"]])
+        return self.encoder(consts["features"][self._seq_ids(batch, consts)])
 
     def __call__(self, batch, consts=None):
         # The reference AttEncoder's out_dim IS num_classes (logits).
-        logits = self.embed(batch, consts)
+        seq_ids = None if "seq" in batch else self._seq_ids(batch, consts)
+        logits = (
+            self.encoder(batch["seq"])
+            if "seq" in batch
+            else self.encoder(consts["features"][seq_ids])
+        )
         labels = base.lookup_labels(
             batch, consts,
-            batch["seq_ids"][:, 0] if "seq_ids" in batch else None,
+            seq_ids[:, 0] if seq_ids is not None else None,
         )
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
@@ -71,11 +94,16 @@ class GAT(base.Model):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        device_sampling: bool = False,
+        train_node_type: int = -1,
     ):
         super().__init__()
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
+        self.max_id = max_id
+        self.init_device_sampling(device_sampling)
+        self.train_node_type = train_node_type
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.feature_idx = feature_idx
@@ -85,15 +113,35 @@ class GAT(base.Model):
         self.edge_type = [edge_type] if np.isscalar(edge_type) else list(
             edge_type
         )
+        self._adj_key = "et" + "_".join(map(str, self.edge_type))
         self.module = _GATModule(
             head_num=head_num,
             hidden_dim=hidden_dim,
             num_classes=num_classes or label_dim,
             sigmoid_loss=sigmoid_loss,
+            nb_num=nb_num,
+            adj_key=self._adj_key,
         )
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            from euler_tpu.graph import device as device_graph
+
+            consts["adj"] = {
+                self._adj_key: device_graph.build_adjacency(
+                    graph, self.edge_type, self.max_id
+                )
+            }
+            consts["roots"] = device_graph.build_node_sampler(
+                graph, self.train_node_type, self.max_id
+            )
+        return consts
 
     def sample(self, graph, inputs) -> dict:
         roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(roots)
         B = len(roots)
         default = self.max_id + 1 if self.max_id >= 0 else -1
         nbrs, _, _ = graph.sample_neighbor(

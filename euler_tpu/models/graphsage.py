@@ -8,7 +8,6 @@ aggregation pyramid + decoder.
 
 from __future__ import annotations
 
-import itertools
 from typing import Optional, Sequence
 
 import flax.linen as nn
@@ -125,11 +124,6 @@ class SupervisedGraphSage(base.Model):
     ):
         super().__init__()
         self.train_node_type = train_node_type
-        if device_sampling and not device_features:
-            raise ValueError(
-                "device_sampling=True requires device_features=True "
-                "(the sampled ids are consumed by on-device gathers)"
-            )
         if device_sampling and sparse_feature_idx:
             raise ValueError(
                 "device_sampling does not support sparse features (no "
@@ -138,10 +132,8 @@ class SupervisedGraphSage(base.Model):
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
-        self.device_sampling = device_sampling and self.device_features
-        # itertools.count: sample() runs in concurrent prefetch workers and
-        # next() is atomic, where += would race and duplicate seeds
-        self._sample_seed = itertools.count(1)
+        self.max_id = max_id
+        self.init_device_sampling(device_sampling)
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.metapath = [list(m) for m in metapath]
@@ -197,16 +189,7 @@ class SupervisedGraphSage(base.Model):
         if self.device_sampling:
             # the fanout happens inside the jitted step; host ships only
             # root ids + a per-batch seed for the device RNG
-            return {
-                "roots": np.clip(inputs, 0, self.max_id + 1).astype(
-                    np.int32
-                ),
-                # [B] so it shards like the rest of the batch; the module
-                # reads element 0 (all equal)
-                "seed": np.full(
-                    len(inputs), next(self._sample_seed), np.int32
-                ),
-            }
+            return self.device_sample_batch(inputs)
         ids_per_hop, _, _ = graph.sample_fanout(
             inputs, self.metapath, self.fanouts, self.default_node
         )
@@ -304,11 +287,16 @@ class ScalableSage(base.ScalableStoreModel):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        device_sampling: bool = False,
+        train_node_type: int = -1,
     ):
         super().__init__()
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
+        self.max_id = max_id
+        self.init_device_sampling(device_sampling)
+        self.train_node_type = train_node_type
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.edge_type = list(edge_type)
@@ -321,6 +309,7 @@ class ScalableSage(base.ScalableStoreModel):
         self.use_id = use_id
         self.store_learning_rate = store_learning_rate
         self.store_init_maxval = store_init_maxval
+        self._adj_key = "et" + "_".join(map(str, self.edge_type))
         self.module = _ScalableSageModule(
             fanout=fanout,
             num_layers=num_layers,
@@ -334,8 +323,49 @@ class ScalableSage(base.ScalableStoreModel):
             embedding_dim=embedding_dim,
         )
 
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            from euler_tpu.graph import device as device_graph
+
+            consts["adj"] = {
+                self._adj_key: device_graph.build_adjacency(
+                    graph, self.edge_type, self.max_id
+                )
+            }
+            consts["roots"] = device_graph.build_node_sampler(
+                graph, self.train_node_type, self.max_id
+            )
+        return consts
+
+    def _expand_batch(self, batch, consts):
+        if "roots" not in batch:
+            return batch
+        import jax
+
+        from euler_tpu.graph import device as device_graph
+
+        roots = batch["roots"]
+        key = jax.random.PRNGKey(batch["seed"][0])
+        neigh = device_graph.sample_neighbor(
+            consts["adj"][self._adj_key], roots, key, self.fanout
+        ).reshape(-1)
+        node_feats = {"gids": roots}
+        neigh_feats = {"gids": neigh}
+        if self.use_id:
+            node_feats["ids"] = roots
+            neigh_feats["ids"] = neigh
+        return {
+            "node_feats": node_feats,
+            "neigh_feats": neigh_feats,
+            "node_ids": roots,
+            "neigh_ids": neigh,
+        }
+
     def sample(self, graph, inputs) -> dict:
         roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(roots)
         ids_per_hop, _, _ = graph.sample_fanout(
             roots, [self.edge_type], [self.fanout], self.max_id + 1
         )
@@ -364,6 +394,10 @@ class _UnsupervisedSageModule(nn.Module):
     embedding_dim: int = 16
     sparse_feature_max_ids: Sequence[int] = ()
     shared_negs: bool = False
+    # device-sampling mode
+    hop_adj_keys: Sequence[str] = ()
+    pos_adj_key: str = ""
+    num_negs: int = 5
 
     def setup(self):
         self.node_encoder = ShallowEncoder(
@@ -396,13 +430,55 @@ class _UnsupervisedSageModule(nn.Module):
         hidden = [self.node_encoder(f) for f in hops]
         return self.encoder(hidden)
 
+    def _device_fanout(self, roots, consts, key):
+        from euler_tpu.graph import device as device_graph
+
+        adjs = [consts["adj"][k] for k in self.hop_adj_keys]
+        ids = device_graph.sample_fanout(
+            adjs, roots, key, list(self.fanouts)
+        )
+        if self.max_id >= 0:
+            return [{"gids": i, "ids": i} for i in ids]
+        return [{"gids": i} for i in ids]
+
+    def _all_hops(self, batch, consts):
+        """(src_hops, pos_hops, neg_hops): host-sampled or built here from
+        roots + seed (positives = 1-hop draws, negatives = global typed
+        draws from consts['negs'])."""
+        if "src_hops" in batch:
+            return (
+                batch["src_hops"],
+                batch.get("pos_hops"),
+                batch.get("neg_hops"),
+            )
+        import jax
+
+        from euler_tpu.graph import device as device_graph
+
+        roots = batch["roots"]
+        key = jax.random.PRNGKey(batch["seed"][0])
+        k_pos, k_neg, k_src, k_p, k_n = jax.random.split(key, 5)
+        pos = device_graph.sample_neighbor(
+            consts["adj"][self.pos_adj_key], roots, k_pos, 1
+        ).reshape(-1)
+        negs = device_graph.sample_node(
+            consts["negs"], k_neg, roots.shape[0] * self.num_negs
+        )
+        return (
+            self._device_fanout(roots, consts, k_src),
+            self._device_fanout(pos, consts, k_p),
+            self._device_fanout(negs, consts, k_n),
+        )
+
     def embed(self, batch, consts=None):
-        return self._encode(batch["src_hops"], False, consts)
+        src_hops, _, _ = self._all_hops(batch, consts)
+        return self._encode(src_hops, False, consts)
 
     def __call__(self, batch, consts=None):
-        emb = self._encode(batch["src_hops"], False, consts)
-        emb_pos = self._encode(batch["pos_hops"], True, consts)
-        emb_negs = self._encode(batch["neg_hops"], True, consts)
+        src_hops, pos_hops, neg_hops = self._all_hops(batch, consts)
+        emb = self._encode(src_hops, False, consts)
+        emb_pos = self._encode(pos_hops, True, consts)
+        emb_negs = self._encode(neg_hops, True, consts)
         B = emb.shape[0]
         emb3 = emb.reshape(B, 1, -1)
         pos3 = emb_pos.reshape(B, 1, -1)
@@ -443,11 +519,14 @@ class GraphSage(base.Model):
         use_id: bool = False,
         embedding_dim: int = 16,
         device_features: bool = False,
+        device_sampling: bool = False,
     ):
         super().__init__()
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
+        self.max_id = max_id
+        self.init_device_sampling(device_sampling)
         self.node_type = node_type
         self.edge_type = list(edge_type)
         self.max_id = max_id
@@ -458,6 +537,10 @@ class GraphSage(base.Model):
         self.feature_dim = feature_dim
         self.use_id = use_id
         self.default_node = max_id + 1
+        self._hop_adj_keys = [
+            "et" + "_".join(map(str, m)) for m in self.metapath
+        ]
+        self._pos_adj_key = "et" + "_".join(map(str, self.edge_type))
         self.module = _UnsupervisedSageModule(
             fanouts=tuple(fanouts),
             dim=dim,
@@ -467,7 +550,34 @@ class GraphSage(base.Model):
             feature_dim=feature_dim if feature_idx >= 0 else 0,
             max_id=max_id if use_id else -1,
             embedding_dim=embedding_dim,
+            hop_adj_keys=tuple(self._hop_adj_keys),
+            pos_adj_key=self._pos_adj_key,
+            num_negs=num_negs,
         )
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            from euler_tpu.graph import device as device_graph
+
+            adj = {}
+            for key, et in zip(
+                self._hop_adj_keys + [self._pos_adj_key],
+                self.metapath + [self.edge_type],
+            ):
+                if key not in adj:
+                    adj[key] = device_graph.build_adjacency(
+                        graph, et, self.max_id
+                    )
+            consts["adj"] = adj
+            # typed negatives (reference: global sample_node(node_type));
+            # roots for the fully-device scanned loop draw from the same
+            # typed sampler, so alias one build
+            consts["negs"] = device_graph.build_node_sampler(
+                graph, self.node_type, self.max_id
+            )
+            consts["roots"] = consts["negs"]
+        return consts
 
     def _hops(self, graph, ids: np.ndarray) -> list:
         ids_per_hop, _, _ = graph.sample_fanout(
@@ -477,6 +587,8 @@ class GraphSage(base.Model):
 
     def sample(self, graph, inputs) -> dict:
         inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(inputs)
         pos, _, _ = graph.sample_neighbor(
             inputs, self.edge_type, 1, self.default_node
         )
@@ -491,4 +603,6 @@ class GraphSage(base.Model):
 
     def sample_embed(self, graph, inputs) -> dict:
         inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.sample(graph, inputs)
         return {"src_hops": self._hops(graph, inputs)}

@@ -114,17 +114,17 @@ def _model_axis_size(mesh: Mesh) -> int:
 def _is_table(path, x) -> bool:
     """True for leaves under a _TABLE_KEYS top-level state key — the
     per-node tables that row-shard (and row-pad) over the model axis.
-    Device-sampling structures (consts['adj'] / consts['roots']) are
-    excluded: their cumulative-weight arrays must stay contiguous and
-    unpadded (zero-padding would unsort the searchsorted input), so they
-    replicate."""
+    Under consts, only the per-node lookup tables (features / labels)
+    shard; device-sampling structures (adj / roots / negs and anything
+    else) replicate — their cumulative-weight arrays must stay contiguous
+    and unpadded (zero-padding would unsort the searchsorted input)."""
     key = path[0]
     name = getattr(key, "key", getattr(key, "idx", None))
     if name not in _TABLE_KEYS or np.ndim(x) < 1:
         return False
     if name == "consts" and len(path) > 1:
         sub = getattr(path[1], "key", getattr(path[1], "idx", None))
-        if sub in ("adj", "roots"):
+        if sub not in ("features", "labels"):
             return False
     return True
 

@@ -101,6 +101,13 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
              "on device (graphsage/gcn/scalable/gat models); ships only "
              "node ids per step",
     )
+    p.add_argument(
+        "--device_sampling", type=_str2bool, default=False,
+        help="also keep the ADJACENCY HBM-resident and sample the fanout "
+             "inside the jitted step (graphsage/graphsage_supervised/"
+             "scalable_sage/gat; implies --device_features); the host "
+             "ships only root ids per step",
+    )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
     p.add_argument("--store_init_maxval", type=float, default=0.05)
@@ -407,7 +414,8 @@ def build_model(args, graph):
             concat=args.concat,
             feature_idx=args.feature_idx,
             feature_dim=args.feature_dim,
-            device_features=args.device_features,
+            device_features=args.device_features or args.device_sampling,
+            device_sampling=args.device_sampling,
         )
     if name == "graphsage_supervised":
         return models.SupervisedGraphSage(
@@ -417,7 +425,9 @@ def build_model(args, graph):
             aggregator=args.aggregator,
             concat=args.concat,
             max_id=args.max_id,
-            device_features=args.device_features,
+            device_features=args.device_features or args.device_sampling,
+            device_sampling=args.device_sampling,
+            train_node_type=args.train_node_type,
             **common_sup,
         )
     if name == "scalable_sage":
@@ -431,7 +441,9 @@ def build_model(args, graph):
             max_id=args.max_id,
             store_learning_rate=args.store_learning_rate,
             store_init_maxval=args.store_init_maxval,
-            device_features=args.device_features,
+            device_features=args.device_features or args.device_sampling,
+            device_sampling=args.device_sampling,
+            train_node_type=args.train_node_type,
             **common_sup,
         )
     if name == "gat":
@@ -446,7 +458,9 @@ def build_model(args, graph):
             head_num=args.head_num,
             hidden_dim=args.dim,
             nb_num=5,
-            device_features=args.device_features,
+            device_features=args.device_features or args.device_sampling,
+            device_sampling=args.device_sampling,
+            train_node_type=args.train_node_type,
         )
     if name == "lshne":
         return models.LsHNE(

@@ -90,6 +90,63 @@ def test_graphsage_learns_neighborhood_labels(planted):
     )
 
 
+def test_graphsage_device_sampling_learns(planted):
+    """The HBM-resident sampling path must reach the same convergence
+    gate as the host path — same distribution, same learning outcome."""
+    from euler_tpu.models import SupervisedGraphSage
+
+    graph, info, feat_acc, hop1_acc = planted
+    model = SupervisedGraphSage(
+        label_idx=0, label_dim=NUM_CLASSES,
+        metapath=[[0], [0]], fanouts=[10, 10], dim=32,
+        feature_idx=1, feature_dim=FEATURE_DIM, max_id=NUM_NODES - 1,
+        sigmoid_loss=False, device_features=True, device_sampling=True,
+    )
+    f1 = _train_and_eval(model, graph)
+    assert f1 > feat_acc + 0.2, (
+        f"device-sampling f1 {f1:.3f} vs feature bound {feat_acc:.3f}"
+    )
+    assert f1 > hop1_acc - MARGIN, (
+        f"device-sampling f1 {f1:.3f} below 1-hop bound "
+        f"{hop1_acc:.3f} - {MARGIN}"
+    )
+
+
+def test_scan_train_learns(planted):
+    """The fully-device scanned loop (roots sampled on device, K steps
+    per dispatch) must ALSO converge — it is the bench's headline path."""
+    import jax
+    import numpy as np
+
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import SupervisedGraphSage
+
+    graph, info, feat_acc, hop1_acc = planted
+    model = SupervisedGraphSage(
+        label_idx=0, label_dim=NUM_CLASSES,
+        metapath=[[0], [0]], fanouts=[10, 10], dim=32,
+        feature_idx=1, feature_dim=FEATURE_DIM, max_id=NUM_NODES - 1,
+        sigmoid_loss=False, device_features=True, device_sampling=True,
+    )
+    opt = train_lib.get_optimizer("adam", 0.01)
+    state = model.init_state(
+        jax.random.PRNGKey(3), graph, graph.sample_node(128, -1), opt
+    )
+    scan = jax.jit(
+        train_lib.make_scan_train(model, opt, inner_steps=50,
+                                  batch_size=128),
+        donate_argnums=(0,),
+    )
+    for chunk in range(6):  # 300 steps
+        state, losses = scan(state, chunk)
+    ids = np.arange(NUM_NODES, dtype=np.int64)
+    batches = [ids[i:i + 400] for i in range(0, NUM_NODES, 400)]
+    f1 = train_lib.evaluate(model, graph, batches, state)["f1"]
+    assert f1 > hop1_acc - MARGIN, (
+        f"scan-train f1 {f1:.3f} below 1-hop bound {hop1_acc:.3f}"
+    )
+
+
 def test_gat_learns_neighborhood_labels(planted):
     from euler_tpu.models import GAT
 

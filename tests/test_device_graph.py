@@ -213,6 +213,45 @@ def test_device_sampling_model_parallel_mesh(graph):
     assert np.isfinite(float(loss))
 
 
+def test_unsup_negs_sampler_survives_model_parallel(graph):
+    """consts['negs'] (the unsupervised negative sampler) must replicate
+    unpadded under model parallelism: zero-padding would unsort its
+    cumulative weights and silently corrupt every negative draw."""
+    import jax
+
+    from euler_tpu import train as train_lib
+    from euler_tpu import models
+    from euler_tpu.parallel import (
+        make_mesh, pad_tables_for_mesh, state_sharding,
+    )
+
+    m = models.GraphSage(
+        node_type=-1, edge_type=[0, 1], max_id=MAX_ID,
+        metapath=[[0, 1]], fanouts=[3], dim=16, num_negs=3,
+        feature_idx=0, feature_dim=2,
+        device_features=True, device_sampling=True,
+    )
+    mesh = make_mesh(8, model_parallel=2)
+    opt = train_lib.get_optimizer("adam", 0.01)
+    state = m.init_state(
+        jax.random.PRNGKey(0), graph, graph.sample_node(8, -1), opt
+    )
+    negs_len = state["consts"]["negs"]["cum"].shape[0]
+    state = pad_tables_for_mesh(state, mesh)
+    assert state["consts"]["negs"]["cum"].shape[0] == negs_len
+    cum = np.asarray(state["consts"]["negs"]["cum"])
+    assert (np.diff(cum) >= 0).all(), "cum must stay sorted"
+    shardings = state_sharding(mesh, state)
+    state = jax.device_put(state, shardings)
+    step = jax.jit(
+        m.make_train_step(opt),
+        in_shardings=(shardings, None),
+        out_shardings=(shardings, None, None),
+    )
+    state, loss, _ = step(state, m.sample(graph, graph.sample_node(8, -1)))
+    assert np.isfinite(float(loss))
+
+
 def test_device_sampling_with_use_id(graph):
     """use_id composes with device_sampling (the gids double as embedding
     ids); sparse features are rejected up front."""
@@ -241,6 +280,59 @@ def test_device_sampling_with_use_id(graph):
             sparse_feature_idx=[0], sparse_feature_max_ids=[5],
             device_features=True, device_sampling=True,
         )
+
+
+@pytest.mark.parametrize("family", ["unsup_sage", "gat", "scalable_sage"])
+def test_device_sampling_model_families(graph, family):
+    """device_sampling generalizes across families: unsupervised GraphSAGE
+    (device positives + typed negatives), GAT (device attention
+    neighborhood), ScalableSage (device 1-hop + store scatter). Each
+    trains via the standard loop AND the fully-device scanned loop."""
+    import jax
+
+    from euler_tpu import train as train_lib
+    from euler_tpu import models
+
+    if family == "unsup_sage":
+        m = models.GraphSage(
+            node_type=-1, edge_type=[0, 1], max_id=MAX_ID,
+            metapath=[[0, 1], [0, 1]], fanouts=[3, 2], dim=16,
+            num_negs=3, feature_idx=0, feature_dim=2,
+            device_features=True, device_sampling=True,
+        )
+    elif family == "gat":
+        m = models.GAT(
+            label_idx=2, label_dim=3, feature_idx=0, feature_dim=2,
+            max_id=MAX_ID, head_num=2, hidden_dim=16, nb_num=4,
+            edge_type=[0, 1],
+            device_features=True, device_sampling=True,
+        )
+    else:
+        m = models.ScalableSage(
+            label_idx=2, label_dim=3, edge_type=[0, 1], fanout=3,
+            num_layers=2, dim=16, max_id=MAX_ID, feature_idx=0,
+            feature_dim=2, device_features=True, device_sampling=True,
+        )
+    batch = m.sample(graph, graph.sample_node(8, -1))
+    assert set(batch) == {"roots", "seed"}
+    state, _ = train_lib.train(
+        m, graph, lambda s: graph.sample_node(8, -1),
+        num_steps=6, learning_rate=0.01, optimizer="adam", log_every=3,
+    )
+    res = train_lib.evaluate(m, graph, [np.arange(16)], state)
+    assert np.isfinite(res["loss"])
+
+    # fully-device scanned loop
+    opt = train_lib.get_optimizer("adam", 0.01)
+    state = m.init_state(
+        jax.random.PRNGKey(0), graph, graph.sample_node(8, -1), opt
+    )
+    scan = jax.jit(
+        train_lib.make_scan_train(m, opt, inner_steps=4, batch_size=8),
+        donate_argnums=(0,),
+    )
+    state, losses = scan(state, 0)
+    assert np.isfinite(np.asarray(losses)).all()
 
 
 def test_remote_graph_rejected(graph, tmp_path):
