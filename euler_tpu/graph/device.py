@@ -203,6 +203,20 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     return jnp.take_along_axis(adj["nbr"][nodes], idx, axis=-1)
 
 
+def random_walk(adj, roots, key, walk_len: int):
+    """[len(roots), walk_len+1] int32 walks sampled on device (column 0 =
+    start). Uniform-or-weighted per-step draws — the p=q=1 fast path of
+    the reference's biased walk (euler/client/graph.cc:196-199); the
+    biased p/q merge stays host-side. Dead ends chain into the default
+    row and stay there, like the host walk's default_node fill."""
+    cur = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
+    cols = [cur]
+    for i in range(walk_len):
+        cur = sample_neighbor(adj, cur, jax.random.fold_in(key, i), 1)[:, 0]
+        cols.append(cur)
+    return jnp.stack(cols, axis=1)
+
+
 def sample_fanout(adjs, roots, key, counts):
     """Fused multi-hop device fanout (host analog: graph.sample_fanout).
 

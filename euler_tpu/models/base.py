@@ -14,7 +14,7 @@ sampler run ahead of the TPU.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -166,20 +166,65 @@ class Model:
         return self.sample(graph, inputs)
 
     # ---- device-resident sampling (euler_tpu/graph/device.py) ----
-    def init_device_sampling(self, device_sampling: bool) -> None:
+    def init_device_sampling(
+        self, device_sampling: bool, require_features: bool = True
+    ) -> None:
         """Resolve the device_sampling flag (call AFTER device_features is
-        resolved) and set up the per-batch seed counter."""
+        resolved) and set up the per-batch seed counter. Models whose
+        encoder can run id-only (shallow embeddings) pass
+        require_features=False."""
         import itertools
 
-        if device_sampling and not self.device_features:
+        if device_sampling and require_features and not self.device_features:
             raise ValueError(
                 "device_sampling=True requires device_features=True "
                 "(the sampled ids are consumed by on-device gathers)"
             )
-        self.device_sampling = device_sampling and self.device_features
+        self.device_sampling = bool(device_sampling) and (
+            self.device_features or not require_features
+        )
         # itertools.count: sample() runs in concurrent prefetch workers
         # and next() is atomic, where += would race and duplicate seeds
         self._sample_seed = itertools.count(1)
+
+    @staticmethod
+    def adj_key(edge_types) -> str:
+        """consts['adj'] key for one edge-type set (shared so every model
+        family and its module agree on the naming)."""
+        return "et" + "_".join(map(str, edge_types))
+
+    def add_sampling_consts(
+        self,
+        consts: dict,
+        graph,
+        edge_type_sets,
+        negs_type: Optional[int] = None,
+        roots_type: Optional[int] = None,
+    ) -> dict:
+        """Upload the device-sampling structures: one adjacency slab per
+        DISTINCT edge-type set plus optional typed node samplers for
+        negatives and scan-loop roots (aliased when the types match)."""
+        from euler_tpu.graph import device as device_graph
+
+        adj = consts.setdefault("adj", {})
+        for et in edge_type_sets:
+            k = self.adj_key(et)
+            if k not in adj:
+                adj[k] = device_graph.build_adjacency(
+                    graph, et, self.max_id
+                )
+        if negs_type is not None:
+            consts["negs"] = device_graph.build_node_sampler(
+                graph, negs_type, self.max_id
+            )
+        if roots_type is not None:
+            if negs_type == roots_type and "negs" in consts:
+                consts["roots"] = consts["negs"]
+            else:
+                consts["roots"] = device_graph.build_node_sampler(
+                    graph, roots_type, self.max_id
+                )
+        return consts
 
     def device_sample_batch(self, inputs) -> dict:
         """The whole per-step host payload in device-sampling mode: root

@@ -48,20 +48,20 @@ class _GATModule(nn.Module):
         )
         return jnp.concatenate([roots[:, None], nbrs], axis=1)
 
-    def embed(self, batch, consts=None):
+    def _logits(self, batch, consts, seq_ids):
         if "seq" in batch:
             return self.encoder(batch["seq"])
         # device-resident features: gather [B, nb+1, fdim] from the table
-        return self.encoder(consts["features"][self._seq_ids(batch, consts)])
+        return self.encoder(consts["features"][seq_ids])
+
+    def embed(self, batch, consts=None):
+        seq_ids = None if "seq" in batch else self._seq_ids(batch, consts)
+        return self._logits(batch, consts, seq_ids)
 
     def __call__(self, batch, consts=None):
         # The reference AttEncoder's out_dim IS num_classes (logits).
         seq_ids = None if "seq" in batch else self._seq_ids(batch, consts)
-        logits = (
-            self.encoder(batch["seq"])
-            if "seq" in batch
-            else self.encoder(consts["features"][seq_ids])
-        )
+        logits = self._logits(batch, consts, seq_ids)
         labels = base.lookup_labels(
             batch, consts,
             seq_ids[:, 0] if seq_ids is not None else None,
@@ -108,12 +108,11 @@ class GAT(base.Model):
         self.label_dim = label_dim
         self.feature_idx = feature_idx
         self.feature_dim = feature_dim
-        self.max_id = max_id
         self.nb_num = nb_num
         self.edge_type = [edge_type] if np.isscalar(edge_type) else list(
             edge_type
         )
-        self._adj_key = "et" + "_".join(map(str, self.edge_type))
+        self._adj_key = self.adj_key(self.edge_type)
         self.module = _GATModule(
             head_num=head_num,
             hidden_dim=hidden_dim,
@@ -126,15 +125,9 @@ class GAT(base.Model):
     def build_consts(self, graph) -> dict:
         consts = super().build_consts(graph)
         if self.device_sampling:
-            from euler_tpu.graph import device as device_graph
-
-            consts["adj"] = {
-                self._adj_key: device_graph.build_adjacency(
-                    graph, self.edge_type, self.max_id
-                )
-            }
-            consts["roots"] = device_graph.build_node_sampler(
-                graph, self.train_node_type, self.max_id
+            self.add_sampling_consts(
+                consts, graph, [self.edge_type],
+                roots_type=self.train_node_type,
             )
         return consts
 

@@ -140,7 +140,6 @@ class SupervisedGraphSage(base.Model):
         self.fanouts = list(fanouts)
         self.feature_idx = feature_idx
         self.feature_dim = feature_dim
-        self.max_id = max_id
         self.use_id = use_id
         self.sparse_feature_idx = list(sparse_feature_idx)
         self.sparse_feature_max_ids = list(sparse_feature_max_ids)
@@ -148,9 +147,7 @@ class SupervisedGraphSage(base.Model):
         self.default_node = max_id + 1 if max_id >= 0 else -1
         # device-sampling: one adjacency slab per distinct hop type-set,
         # hops referencing the same set share one upload
-        self._hop_adj_keys = [
-            "et" + "_".join(map(str, m)) for m in self.metapath
-        ]
+        self._hop_adj_keys = [self.adj_key(m) for m in self.metapath]
         self.module = _SupervisedSageModule(
             fanouts=tuple(fanouts),
             dim=dim,
@@ -168,19 +165,9 @@ class SupervisedGraphSage(base.Model):
     def build_consts(self, graph) -> dict:
         consts = super().build_consts(graph)
         if self.device_sampling:
-            from euler_tpu.graph import device as device_graph
-
-            adj = {}
-            for key, et in zip(self._hop_adj_keys, self.metapath):
-                if key not in adj:
-                    adj[key] = device_graph.build_adjacency(
-                        graph, et, self.max_id
-                    )
-            consts["adj"] = adj
-            # weighted root sampler for the fully-device scanned loop
-            # (train.make_scan_train); harmless extra [N] arrays otherwise
-            consts["roots"] = device_graph.build_node_sampler(
-                graph, self.train_node_type, self.max_id
+            self.add_sampling_consts(
+                consts, graph, self.metapath,
+                roots_type=self.train_node_type,
             )
         return consts
 
@@ -309,7 +296,7 @@ class ScalableSage(base.ScalableStoreModel):
         self.use_id = use_id
         self.store_learning_rate = store_learning_rate
         self.store_init_maxval = store_init_maxval
-        self._adj_key = "et" + "_".join(map(str, self.edge_type))
+        self._adj_key = self.adj_key(self.edge_type)
         self.module = _ScalableSageModule(
             fanout=fanout,
             num_layers=num_layers,
@@ -326,15 +313,9 @@ class ScalableSage(base.ScalableStoreModel):
     def build_consts(self, graph) -> dict:
         consts = super().build_consts(graph)
         if self.device_sampling:
-            from euler_tpu.graph import device as device_graph
-
-            consts["adj"] = {
-                self._adj_key: device_graph.build_adjacency(
-                    graph, self.edge_type, self.max_id
-                )
-            }
-            consts["roots"] = device_graph.build_node_sampler(
-                graph, self.train_node_type, self.max_id
+            self.add_sampling_consts(
+                consts, graph, [self.edge_type],
+                roots_type=self.train_node_type,
             )
         return consts
 
@@ -529,7 +510,6 @@ class GraphSage(base.Model):
         self.init_device_sampling(device_sampling)
         self.node_type = node_type
         self.edge_type = list(edge_type)
-        self.max_id = max_id
         self.metapath = [list(m) for m in metapath]
         self.fanouts = list(fanouts)
         self.num_negs = num_negs
@@ -537,10 +517,8 @@ class GraphSage(base.Model):
         self.feature_dim = feature_dim
         self.use_id = use_id
         self.default_node = max_id + 1
-        self._hop_adj_keys = [
-            "et" + "_".join(map(str, m)) for m in self.metapath
-        ]
-        self._pos_adj_key = "et" + "_".join(map(str, self.edge_type))
+        self._hop_adj_keys = [self.adj_key(m) for m in self.metapath]
+        self._pos_adj_key = self.adj_key(self.edge_type)
         self.module = _UnsupervisedSageModule(
             fanouts=tuple(fanouts),
             dim=dim,
@@ -558,25 +536,12 @@ class GraphSage(base.Model):
     def build_consts(self, graph) -> dict:
         consts = super().build_consts(graph)
         if self.device_sampling:
-            from euler_tpu.graph import device as device_graph
-
-            adj = {}
-            for key, et in zip(
-                self._hop_adj_keys + [self._pos_adj_key],
-                self.metapath + [self.edge_type],
-            ):
-                if key not in adj:
-                    adj[key] = device_graph.build_adjacency(
-                        graph, et, self.max_id
-                    )
-            consts["adj"] = adj
             # typed negatives (reference: global sample_node(node_type));
-            # roots for the fully-device scanned loop draw from the same
-            # typed sampler, so alias one build
-            consts["negs"] = device_graph.build_node_sampler(
-                graph, self.node_type, self.max_id
+            # scan-loop roots alias the same typed sampler
+            self.add_sampling_consts(
+                consts, graph, self.metapath + [self.edge_type],
+                negs_type=self.node_type, roots_type=self.node_type,
             )
-            consts["roots"] = consts["negs"]
         return consts
 
     def _hops(self, graph, ids: np.ndarray) -> list:

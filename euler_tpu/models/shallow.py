@@ -30,6 +30,12 @@ class _ShallowUnsupModule(nn.Module):
     xent_loss: bool = False
     num_negs: int = 5
     share_context: bool = False  # LINE first-order shares the encoder
+    # device-sampling mode: LINE when walk_len == 0, Node2Vec otherwise
+    adj_key: str = ""
+    walk_len: int = 0
+    left_win: int = 0
+    right_win: int = 0
+    has_features: bool = False
 
     def setup(self):
         kw = dict(
@@ -47,13 +53,54 @@ class _ShallowUnsupModule(nn.Module):
     def _context(self, x):
         return self.target(x) if self.share_context else self.context(x)
 
-    def embed(self, batch):
-        return self.target(batch["src"])
+    def _feats(self, ids):
+        f = {}
+        if self.max_id >= 0:
+            f["ids"] = ids
+        if self.has_features:
+            f["gids"] = ids
+        return f
 
-    def __call__(self, batch):
-        emb = self.target(batch["src"])  # [B, d]
-        emb_pos = self._context(batch["pos"])  # [B, d]
-        emb_negs = self._context(batch["negs"])  # [B*negs, d]
+    def _inputs(self, batch, consts):
+        """(src, pos, negs) encoder inputs: host-sampled or derived here
+        from roots + seed (LINE: 1-hop positives; Node2Vec: device walks
+        -> skip-gram pairs)."""
+        if "src" in batch:
+            return batch["src"], batch.get("pos"), batch.get("negs")
+        import jax
+
+        from euler_tpu.graph import device as device_graph
+
+        roots = batch["roots"]
+        key = jax.random.PRNGKey(batch["seed"][0])
+        k_walk, k_neg = jax.random.split(key)
+        adj = consts["adj"][self.adj_key]
+        if self.walk_len > 0:
+            paths = device_graph.random_walk(
+                adj, roots, k_walk, self.walk_len
+            )
+            ti, ci = ops.walk.pair_indices(
+                self.walk_len + 1, self.left_win, self.right_win
+            )
+            src = paths[:, ti].reshape(-1)
+            pos = paths[:, ci].reshape(-1)
+        else:
+            src = roots
+            pos = device_graph.sample_neighbor(adj, roots, k_walk, 1)[:, 0]
+        negs = device_graph.sample_node(
+            consts["negs"], k_neg, src.shape[0] * self.num_negs
+        )
+        return self._feats(src), self._feats(pos), self._feats(negs)
+
+    def embed(self, batch, consts=None):
+        src, _, _ = self._inputs(batch, consts)
+        return self.target(base.gather_consts(src, consts))
+
+    def __call__(self, batch, consts=None):
+        src, pos, negs = self._inputs(batch, consts)
+        emb = self.target(base.gather_consts(src, consts))  # [B, d]
+        emb_pos = self._context(base.gather_consts(pos, consts))
+        emb_negs = self._context(base.gather_consts(negs, consts))
         B = emb.shape[0]
         loss, mrr = base.unsupervised_decoder(
             emb.reshape(B, 1, -1),
@@ -83,8 +130,14 @@ class _ShallowUnsupervised(base.Model):
         sparse_feature_max_ids: Sequence[int] = (),
         sparse_max_len: int = 16,
         num_negs: int = 5,
+        device_features: bool = False,
+        device_sampling: bool = False,
     ):
         super().__init__()
+        if device_sampling and sparse_feature_idx:
+            raise ValueError(
+                "device_sampling does not support sparse features"
+            )
         self.node_type = node_type
         self.max_id = max_id
         self.feature_idx = feature_idx
@@ -94,6 +147,27 @@ class _ShallowUnsupervised(base.Model):
         self.sparse_feature_max_ids = list(sparse_feature_max_ids)
         self.sparse_max_len = sparse_max_len
         self.num_negs = num_negs
+        self.device_features = base.resolve_device_features(
+            device_features, feature_idx, max_id
+        )
+        # the id-embedding path needs no feature table: device_sampling
+        # composes with use_id alone (device_features only required when
+        # dense features are configured)
+        if device_sampling and feature_idx >= 0 and not self.device_features:
+            raise ValueError(
+                "device_sampling with dense features requires "
+                "device_features=True"
+            )
+        self.init_device_sampling(device_sampling, require_features=False)
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            self.add_sampling_consts(
+                consts, graph, [self.edge_type],
+                negs_type=self.node_type, roots_type=self.node_type,
+            )
+        return consts
 
     def _pack(self, graph, src, pos, negs) -> dict:
         return {
@@ -137,10 +211,14 @@ class LINE(_ShallowUnsupervised):
             xent_loss=xent_loss,
             num_negs=self.num_negs,
             share_context=order in (1, "first"),
+            adj_key=self.adj_key(self.edge_type),
+            has_features=self.device_features,
         )
 
     def sample(self, graph, inputs) -> dict:
         src = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(src)
         pos, _, _ = graph.sample_neighbor(
             src, self.edge_type, 1, self.max_id + 1
         )
@@ -171,6 +249,15 @@ class Node2Vec(_ShallowUnsupervised):
         **kwargs,
     ):
         super().__init__(node_type, max_id, **kwargs)
+        if self.device_sampling and (walk_p != 1.0 or walk_q != 1.0):
+            # the biased walk needs the sorted-merge d_tx reweighting
+            # (reference graph.cc:120-151) — host-only; p=q=1 degenerates
+            # to plain neighbor draws, the same fast path the reference
+            # takes (graph.cc:196-199)
+            raise ValueError(
+                "device_sampling supports p=q=1 walks only; use the host "
+                "path for biased node2vec"
+            )
         self.edge_type = list(edge_type)
         self.walk_len = walk_len
         self.walk_p = walk_p
@@ -189,10 +276,17 @@ class Node2Vec(_ShallowUnsupervised):
             combiner=combiner,
             xent_loss=xent_loss,
             num_negs=self.num_negs,
+            adj_key=self.adj_key(self.edge_type),
+            walk_len=walk_len,
+            left_win=left_win_size,
+            right_win=right_win_size,
+            has_features=self.device_features,
         )
 
     def sample(self, graph, inputs) -> dict:
         roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(roots)
         paths = graph.random_walk(
             roots,
             self.edge_type,

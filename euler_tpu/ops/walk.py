@@ -29,28 +29,33 @@ def pair_count(path_len: int, left_win: int, right_win: int) -> int:
     return count
 
 
-def gen_pair(paths, left_win_size: int, right_win_size: int) -> np.ndarray:
-    """[batch, pair_count, 2] (target, context) pairs.
+def pair_indices(path_len: int, left_win: int, right_win: int):
+    """Static (target, context) position index arrays for skip-gram pair
+    enumeration — shared by the host gen_pair and the on-device walk path
+    (euler_tpu/graph/device.py), so both enumerate in the reference
+    kernel's order: positions j = 0..len-1, left contexts j-1, j-2, ...,
+    then right contexts j+1, j+2, ..."""
+    blocks = []
+    for j in range(path_len):
+        for k in range(left_win):
+            if j - k - 1 >= 0:
+                blocks.append((j, j - k - 1))
+        for k in range(right_win):
+            if j + k + 1 < path_len:
+                blocks.append((j, j + k + 1))
+    tgt = np.array([b[0] for b in blocks], dtype=np.int32)
+    ctx = np.array([b[1] for b in blocks], dtype=np.int32)
+    return tgt, ctx
 
-    Enumeration order per row matches the reference kernel: positions
-    j = 0..len-1, for each j the left contexts j-1, j-2, ... then the right
-    contexts j+1, j+2, ...
-    """
+
+def gen_pair(paths, left_win_size: int, right_win_size: int) -> np.ndarray:
+    """[batch, pair_count, 2] (target, context) pairs."""
     paths = np.asarray(paths, dtype=np.int64)
     if paths.ndim == 1:
         paths = paths[None, :]
     batch, path_len = paths.shape
-    blocks = []
-    for j in range(path_len):
-        for k in range(left_win_size):
-            if j - k - 1 >= 0:
-                blocks.append((j, j - k - 1))
-        for k in range(right_win_size):
-            if j + k + 1 < path_len:
-                blocks.append((j, j + k + 1))
-    if not blocks:
+    tgt_idx, ctx_idx = pair_indices(path_len, left_win_size, right_win_size)
+    if len(tgt_idx) == 0:
         return np.zeros((batch, 0, 2), dtype=np.int64)
-    tgt_idx = np.array([b[0] for b in blocks])
-    ctx_idx = np.array([b[1] for b in blocks])
     pairs = np.stack([paths[:, tgt_idx], paths[:, ctx_idx]], axis=-1)
     return pairs

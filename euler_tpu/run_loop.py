@@ -103,10 +103,12 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     )
     p.add_argument(
         "--device_sampling", type=_str2bool, default=False,
-        help="also keep the ADJACENCY HBM-resident and sample the fanout "
-             "inside the jitted step (graphsage/graphsage_supervised/"
-             "scalable_sage/gat; implies --device_features); the host "
-             "ships only root ids per step",
+        help="also keep the ADJACENCY HBM-resident and sample fanouts/"
+             "walks inside the jitted step (graphsage, "
+             "graphsage_supervised, scalable_sage, gat, line, node2vec "
+             "with p=q=1); the host ships only root ids per step. For "
+             "feature models this implies --device_features; the shallow "
+             "id-embedding models run it standalone",
     )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
@@ -353,6 +355,7 @@ def build_model(args, graph):
             xent_loss=args.xent_loss,
             num_negs=args.num_negs,
             order=args.order,
+            device_sampling=args.device_sampling,
         )
     if name in ("randomwalk", "deepwalk", "node2vec"):
         return models.Node2Vec(
@@ -367,6 +370,7 @@ def build_model(args, graph):
             walk_q=args.walk_q,
             left_win_size=args.left_win_size,
             right_win_size=args.right_win_size,
+            device_sampling=args.device_sampling,
         )
     if name in ("gcn", "gcn_supervised"):
         # Full-neighbor GCN needs per-hop dense caps for static shapes.

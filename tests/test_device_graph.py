@@ -282,11 +282,15 @@ def test_device_sampling_with_use_id(graph):
         )
 
 
-@pytest.mark.parametrize("family", ["unsup_sage", "gat", "scalable_sage"])
+@pytest.mark.parametrize(
+    "family",
+    ["unsup_sage", "gat", "scalable_sage", "line", "node2vec"],
+)
 def test_device_sampling_model_families(graph, family):
     """device_sampling generalizes across families: unsupervised GraphSAGE
     (device positives + typed negatives), GAT (device attention
-    neighborhood), ScalableSage (device 1-hop + store scatter). Each
+    neighborhood), ScalableSage (device 1-hop + store scatter), LINE
+    (device positives), Node2Vec (device walks -> skip-gram pairs). Each
     trains via the standard loop AND the fully-device scanned loop."""
     import jax
 
@@ -306,6 +310,17 @@ def test_device_sampling_model_families(graph, family):
             max_id=MAX_ID, head_num=2, hidden_dim=16, nb_num=4,
             edge_type=[0, 1],
             device_features=True, device_sampling=True,
+        )
+    elif family == "line":
+        m = models.LINE(
+            node_type=-1, edge_type=[0, 1], max_id=MAX_ID, dim=16,
+            order=2, num_negs=3, device_sampling=True,
+        )
+    elif family == "node2vec":
+        m = models.Node2Vec(
+            node_type=-1, edge_type=[0, 1], max_id=MAX_ID, dim=16,
+            walk_len=3, left_win_size=1, right_win_size=1, num_negs=3,
+            device_sampling=True,
         )
     else:
         m = models.ScalableSage(
