@@ -51,11 +51,13 @@ def build_adjacency(
 ) -> dict:
     """Export the adjacency restricted to ``edge_types`` as device slabs.
 
-    Returns {"nbr": [N+2, W] int32, "cum": [N+2, W] float32} with
-    N = max_id + 1; W = observed max degree (or ``max_degree`` cap — rows
-    beyond it are truncated to their W heaviest neighbors and renormalized,
-    with a warning). Unknown ids and the default row sample the default
-    node (max_id + 1).
+    Returns {"nbr": [N+2, W] int32, "cum": [N+2, W] float32,
+    "deg": [N+2] int32} with N = max_id + 1; W = observed max degree (or
+    ``max_degree`` cap — rows beyond it are truncated to their W heaviest
+    neighbors and renormalized, with a warning). ``deg`` is the in-slab
+    neighbor count (min(true degree, W)) — the full-neighborhood models
+    mask padding slots with it. Unknown ids and the default row sample
+    the default node (max_id + 1).
     """
     n_rows = max_id + 2
     default = max_id + 1
@@ -107,14 +109,18 @@ def build_adjacency(
     has = counts_all > 0
     cum_out[np.flatnonzero(has),
             np.minimum(counts_all[has], W) - 1] = 1.0
-    # rows whose weights sum to 0 are unsampleable: host semantics fill
-    # the default node (the nan cum rows from 0/0 are overwritten here)
+    # rows whose weights sum to 0 are UNSAMPLEABLE (host sampling fills
+    # the default node) but their neighbors still EXIST (host
+    # GetFullNeighbor returns them, and the full-neighborhood GCN
+    # aggregates them) — so keep nbr/deg intact, neutralize the nan cum,
+    # and record unsampleability separately for sample_neighbor
     zero_w = np.flatnonzero(
         has & (csum_z[offsets[1:]] - csum_z[offsets[:-1]] <= 0)
     )
+    sampleable = np.ones(n_rows, dtype=bool)
     if len(zero_w):
-        nbr_out[zero_w] = default
         cum_out[zero_w] = 1.0
+        sampleable[zero_w] = False
 
     # rows beyond the cap: redo exactly (keep the heaviest W neighbors)
     for i in truncated:
@@ -137,7 +143,13 @@ def build_adjacency(
             f"max_degree={W}; truncated to their heaviest neighbors "
             "(renormalized)"
         )
-    return {"nbr": nbr_out, "cum": cum_out}
+    deg = np.minimum(counts_all, W).astype(np.int32)
+    return {
+        "nbr": nbr_out,
+        "cum": cum_out,
+        "deg": deg,
+        "sampleable": sampleable,
+    }
 
 
 def build_node_sampler(graph, node_type: int = -1, max_id: int = 0) -> dict:
@@ -200,7 +212,11 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     # index = #thresholds strictly below u  (u < cum[0] -> 0, ...)
     idx = (u[..., None] >= cum[..., None, :]).sum(-1)
     idx = jnp.clip(idx, 0, adj["nbr"].shape[1] - 1)
-    return jnp.take_along_axis(adj["nbr"][nodes], idx, axis=-1)
+    out = jnp.take_along_axis(adj["nbr"][nodes], idx, axis=-1)
+    # rows with zero total weight have neighbors but no sampling mass:
+    # the host engine fills the default node there
+    default = adj["nbr"].shape[0] - 1
+    return jnp.where(adj["sampleable"][nodes][..., None], out, default)
 
 
 def random_walk(adj, roots, key, walk_len: int):

@@ -258,17 +258,21 @@ class ScalableGCN(base.ScalableStoreModel):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        device_sampling: bool = False,
+        train_node_type: int = -1,
     ):
         super().__init__()
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
+        self.max_id = max_id
+        self.init_device_sampling(device_sampling)
+        self.train_node_type = train_node_type
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.edge_type = list(edge_type)
         self.num_layers = num_layers
         self.dim = dim
-        self.max_id = max_id
         # Per-ROOT caps: the reference expands the full ragged 1-hop
         # neighborhood (encoders.py:262 get_multi_hop_neighbor); for static
         # TPU shapes we pad to batch * max_neighbors unique neighbors and
@@ -297,8 +301,54 @@ class ScalableGCN(base.ScalableStoreModel):
         # full-neighbor GCN; use SupervisedGCN with the trained params for
         # exact evaluation.
 
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            self.add_sampling_consts(
+                consts, graph, [self.edge_type],
+                roots_type=self.train_node_type,
+            )
+        return consts
+
+    def _expand_batch(self, batch, consts):
+        """Device full-neighbor expansion: the adjacency slab row IS the
+        1-hop neighborhood (padded to W, masked by degree) — no host
+        dedup; duplicate neighbor slots scatter-add like duplicate edges.
+        """
+        if "roots" not in batch:
+            return batch
+        slab = consts["adj"][self.adj_key(self.edge_type)]
+        roots = batch["roots"]
+        B = roots.shape[0]
+        W = slab["nbr"].shape[1]
+        nbrs = slab["nbr"][roots]                      # [B, W]
+        deg = slab["deg"][roots]                       # [B]
+        mask = (
+            jnp.arange(W, dtype=jnp.int32)[None, :] < deg[:, None]
+        ).astype(jnp.float32)
+        flat = nbrs.reshape(-1)
+        adj = {
+            "src": jnp.repeat(jnp.arange(B, dtype=jnp.int32), W),
+            "dst": jnp.arange(B * W, dtype=jnp.int32),
+            "mask": mask.reshape(-1),
+        }
+        node_feats = {"gids": roots}
+        neigh_feats = {"gids": flat}
+        if self.use_id:
+            node_feats["ids"] = roots
+            neigh_feats["ids"] = flat
+        return {
+            "node_feats": node_feats,
+            "neigh_feats": neigh_feats,
+            "node_ids": roots,
+            "neigh_ids": flat,
+            "adj": adj,
+        }
+
     def sample(self, graph, inputs) -> dict:
         roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(roots)
         B = len(roots)
         roots_out, hops = ops.get_multi_hop_neighbor(
             graph,

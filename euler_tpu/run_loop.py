@@ -401,7 +401,9 @@ def build_model(args, graph):
             use_residual=args.use_residual,
             store_learning_rate=args.store_learning_rate,
             store_init_maxval=args.store_init_maxval,
-            device_features=args.device_features,
+            device_features=args.device_features or args.device_sampling,
+            device_sampling=args.device_sampling,
+            train_node_type=args.train_node_type,
             **common_sup,
         )
     if name == "graphsage":

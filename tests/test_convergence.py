@@ -202,3 +202,18 @@ def test_gcn_and_scalable_gcn_converge_within_tolerance(planted):
         f"stale-store ScalableGCN f1 {f1_scal:.3f} degrades more than "
         f"0.05 below plain GCN {f1_gcn:.3f}"
     )
+
+    # the device full-neighborhood path (adjacency slab, no host dedup)
+    # must converge equivalently
+    scal_dev = ScalableGCN(
+        label_idx=0, label_dim=NUM_CLASSES,
+        edge_type=[0], num_layers=2, dim=32,
+        max_id=NUM_NODES - 1, max_neighbors=10,
+        feature_idx=1, feature_dim=FEATURE_DIM,
+        sigmoid_loss=False, device_features=True, device_sampling=True,
+    )
+    f1_dev = _train_and_eval(scal_dev, graph, batch=96)
+    assert f1_dev > f1_gcn - 0.05, (
+        f"device-sampling ScalableGCN f1 {f1_dev:.3f} degrades more "
+        f"than 0.05 below plain GCN {f1_gcn:.3f}"
+    )

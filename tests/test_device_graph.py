@@ -108,6 +108,51 @@ def test_fanout_chains_through_dead_ends(graph, adj01):
             assert (h2[i] == MAX_ID + 1).all()
 
 
+def test_zero_weight_neighbors_exist_but_never_sample(tmp_path):
+    """A node whose edges all weigh 0: the host engine returns the
+    neighbors from GetFullNeighbor (they EXIST — the full-neighborhood
+    GCN aggregates them) but can never sample them. The slab must encode
+    both: nbr/deg keep the neighbors, sample_neighbor yields default."""
+    import jax
+
+    import euler_tpu
+    from euler_tpu.graph.convert import convert_dicts
+
+    meta = {
+        "node_type_num": 1, "edge_type_num": 1,
+        "node_uint64_feature_num": 0, "node_float_feature_num": 0,
+        "node_binary_feature_num": 0, "edge_uint64_feature_num": 0,
+        "edge_float_feature_num": 0, "edge_binary_feature_num": 0,
+    }
+    nodes = [
+        {"node_id": 0, "node_type": 0, "node_weight": 1.0,
+         "neighbor": {"0": {"1": 0.0, "2": 0.0}},  # all-zero weights
+         "uint64_feature": {}, "float_feature": {}, "binary_feature": {},
+         "edge": []},
+        {"node_id": 1, "node_type": 0, "node_weight": 1.0,
+         "neighbor": {"0": {"2": 1.0}}, "uint64_feature": {},
+         "float_feature": {}, "binary_feature": {}, "edge": []},
+        {"node_id": 2, "node_type": 0, "node_weight": 1.0,
+         "neighbor": {"0": {}}, "uint64_feature": {},
+         "float_feature": {}, "binary_feature": {}, "edge": []},
+    ]
+    convert_dicts(nodes, meta, str(tmp_path / "part"), 1)
+    g = euler_tpu.Graph(directory=str(tmp_path))
+    adj = device.build_adjacency(g, [0], 2)
+    # existence: both zero-weight neighbors are in the slab
+    assert adj["deg"][0] == 2
+    assert set(adj["nbr"][0, :2].tolist()) == {1, 2}
+    # sampling: node 0 yields only the default node (host semantics)
+    out = np.asarray(
+        device.sample_neighbor(
+            adj, np.array([0, 1]), jax.random.PRNGKey(0), 16
+        )
+    )
+    assert (out[0] == 3).all()   # default = max_id + 1
+    assert (out[1] == 2).all()
+    g.close()
+
+
 def test_truncation_keeps_heaviest(graph):
     with pytest.warns(UserWarning, match="truncated"):
         adj = device.build_adjacency(graph, [0, 1], MAX_ID, max_degree=1)
@@ -284,7 +329,8 @@ def test_device_sampling_with_use_id(graph):
 
 @pytest.mark.parametrize(
     "family",
-    ["unsup_sage", "gat", "scalable_sage", "line", "node2vec"],
+    ["unsup_sage", "gat", "scalable_sage", "scalable_gcn", "line",
+     "node2vec"],
 )
 def test_device_sampling_model_families(graph, family):
     """device_sampling generalizes across families: unsupervised GraphSAGE
@@ -321,6 +367,12 @@ def test_device_sampling_model_families(graph, family):
             node_type=-1, edge_type=[0, 1], max_id=MAX_ID, dim=16,
             walk_len=3, left_win_size=1, right_win_size=1, num_negs=3,
             device_sampling=True,
+        )
+    elif family == "scalable_gcn":
+        m = models.ScalableGCN(
+            label_idx=2, label_dim=3, edge_type=[0, 1], num_layers=2,
+            dim=16, max_id=MAX_ID, max_neighbors=6, feature_idx=0,
+            feature_dim=2, device_features=True, device_sampling=True,
         )
     else:
         m = models.ScalableSage(
