@@ -224,13 +224,106 @@ def random_walk(adj, roots, key, walk_len: int):
     start). Uniform-or-weighted per-step draws — the p=q=1 fast path of
     the reference's biased walk (euler/client/graph.cc:196-199); the
     biased p/q merge stays host-side. Dead ends chain into the default
-    row and stay there, like the host walk's default_node fill."""
+    row and stay there, like the host walk's default_node fill.
+
+    ``adj`` is one adjacency dict (homogeneous walk) or a per-step list
+    of walk_len dicts (heterogeneous metapath walk, the LsHNE pattern)."""
+    adjs = adj if isinstance(adj, (list, tuple)) else [adj] * walk_len
+    if len(adjs) != walk_len:
+        raise ValueError(
+            f"metapath walk needs {walk_len} per-step adjacencies, "
+            f"got {len(adjs)}"
+        )
     cur = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
     cols = [cur]
     for i in range(walk_len):
-        cur = sample_neighbor(adj, cur, jax.random.fold_in(key, i), 1)[:, 0]
+        cur = sample_neighbor(
+            adjs[i], cur, jax.random.fold_in(key, i), 1
+        )[:, 0]
         cols.append(cur)
     return jnp.stack(cols, axis=1)
+
+
+def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
+    """Per-node-type weighted samplers packed into one flat layout for the
+    device sample_node_with_src (reference sample_node_with_src semantics:
+    each source draws negatives from ITS node type's global sampler,
+    tf_euler euler_ops/sample_ops.py:39-67).
+
+    Returns {"ids": [M] int32 (nodes sorted by type), "cum": [M] float32
+    (cumulative weights normalized WITHIN each type segment),
+    "off": [T+1] int32 segment offsets, "types": [N+2] int32 node-type
+    lookup (-1 for unknown/default)}.
+    """
+    all_ids = np.arange(max_id + 1, dtype=np.int64)
+    weights = graph.node_weights(all_ids)
+    types = graph.node_types(all_ids)
+    type_table = np.full(max_id + 2, -1, dtype=np.int32)
+    type_table[: max_id + 1] = types
+
+    ids_out: list[np.ndarray] = []
+    cum_out: list[np.ndarray] = []
+    off = [0]
+    for t in range(num_types):
+        mask = (types == t) & (weights > 0)
+        tids = all_ids[mask]
+        tw = weights[mask].astype(np.float64)
+        if len(tids):
+            c = np.cumsum(tw)
+            c /= c[-1]
+        else:
+            c = np.zeros(0)
+        ids_out.append(tids)
+        cum_out.append(c)
+        off.append(off[-1] + len(tids))
+    ids_cat = (
+        np.concatenate(ids_out) if off[-1] else np.zeros(0, np.int64)
+    )
+    cum_cat = (
+        np.concatenate(cum_out) if off[-1] else np.zeros(0, np.float64)
+    )
+    return {
+        "ids": ids_cat.astype(np.int32),
+        "cum": cum_cat.astype(np.float32),
+        "off": np.asarray(off, dtype=np.int32),
+        "types": type_table,
+    }
+
+
+def sample_node_with_src(tsampler: dict, src, key, count: int):
+    """[len(src), count] int32 negatives: each source draws from its own
+    node type's weighted sampler (device analog of the native
+    eg_sample_node_with_src). Sources of unknown/default type fall back
+    to type 0's segment. Bisection over the per-type cum segments —
+    fixed-depth binary search, fully vectorized."""
+    src = jnp.asarray(src, dtype=jnp.int32).reshape(-1)
+    t = tsampler["types"][src]
+    # clamp out-of-range types into the sampler's range (mirrors the
+    # TypedDense tower clamping): unknown (<0) falls to type 0, types
+    # beyond the configured count to the last segment — never the
+    # accidental empty-segment path, which would silently train against
+    # all-default (zero-feature) negatives
+    num_types = tsampler["off"].shape[0] - 1
+    t = jnp.clip(t, 0, num_types - 1)
+    lo = tsampler["off"][t][:, None].astype(jnp.int32)
+    hi = tsampler["off"][t + 1][:, None].astype(jnp.int32)
+    lo = jnp.broadcast_to(lo, (src.shape[0], count))
+    hi = jnp.broadcast_to(hi, (src.shape[0], count))
+    empty = hi <= lo
+    u = jax.random.uniform(key, (src.shape[0], count))
+    cum = tsampler["cum"]
+    M = max(int(cum.shape[0]), 1)
+    steps = max(M.bit_length(), 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        go_right = cum[jnp.clip(mid, 0, M - 1)] < u
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    idx = jnp.clip(lo, 0, M - 1)
+    out = tsampler["ids"][idx]
+    default = tsampler["types"].shape[0] - 1
+    return jnp.where(empty, default, out)
 
 
 def sample_fanout(adjs, roots, key, counts):

@@ -96,10 +96,18 @@ def shared_negs_decoder(emb, emb_pos, emb_negs, xent_loss: bool):
 
 def gather_consts(feats: dict, consts: dict) -> dict:
     """Materialize device-resident features for one node set: replace the
-    host-side 'gids' indices with a gather from the HBM-resident table."""
-    if consts and "gids" in feats and "features" in consts:
-        feats = dict(feats)
-        feats["dense"] = consts["features"][feats["gids"]]
+    host-side 'gids' indices with gathers from the HBM-resident tables
+    (dense rows, and padded sparse id+mask rows when configured)."""
+    if not consts or "gids" not in feats:
+        return feats
+    feats = dict(feats)
+    g = feats["gids"]
+    if "features" in consts:
+        feats["dense"] = consts["features"][g]
+    if "sparse" in consts and "sparse" not in feats:
+        feats["sparse"] = [
+            (t["ids"][g], t["mask"][g]) for t in consts["sparse"]
+        ]
     return feats
 
 
@@ -118,13 +126,17 @@ def lookup_labels(batch: dict, consts: dict, root_ids):
 
 
 def resolve_device_features(
-    device_features: bool, feature_idx: int, max_id: int
+    device_features: bool,
+    feature_idx: int,
+    max_id: int,
+    has_sparse: bool = False,
 ) -> bool:
     """Validate a model's device_features request. Silently off when the
-    model has no dense features; a hard error when max_id is unset, because
-    the table would have one row and every id would clip to it — silently
-    training all nodes on node 0's features."""
-    if not device_features or feature_idx < 0:
+    model has no dense (or sparse, when has_sparse) features; a hard error
+    when max_id is unset, because the table would have one row and every
+    id would clip to it — silently training all nodes on node 0's
+    features."""
+    if not device_features or (feature_idx < 0 and not has_sparse):
         return False
     if max_id < 0:
         raise ValueError(
@@ -200,10 +212,13 @@ class Model:
         edge_type_sets,
         negs_type: Optional[int] = None,
         roots_type: Optional[int] = None,
+        max_degree: Optional[int] = None,
     ) -> dict:
         """Upload the device-sampling structures: one adjacency slab per
         DISTINCT edge-type set plus optional typed node samplers for
-        negatives and scan-loop roots (aliased when the types match)."""
+        negatives and scan-loop roots (aliased when the types match).
+        ``max_degree`` caps the slab width on heavy-tailed graphs
+        (heaviest neighbors kept, build_adjacency warns)."""
         from euler_tpu.graph import device as device_graph
 
         adj = consts.setdefault("adj", {})
@@ -211,7 +226,7 @@ class Model:
             k = self.adj_key(et)
             if k not in adj:
                 adj[k] = device_graph.build_adjacency(
-                    graph, et, self.max_id
+                    graph, et, self.max_id, max_degree=max_degree
                 )
         if negs_type is not None:
             consts["negs"] = device_graph.build_node_sampler(
@@ -262,15 +277,23 @@ class Model:
                 )
         sparse_idx = getattr(self, "sparse_feature_idx", [])
         if sparse_idx:
-            feats["sparse"] = ops.get_sparse_feature(
-                graph,
-                ids,
-                sparse_idx,
-                self.sparse_max_len,
-                default_values=[
-                    m + 1 for m in self.sparse_feature_max_ids
-                ],
-            )
+            if self.device_features:
+                # the padded sparse tables live in consts (build_consts);
+                # the module gathers rows at gids on device
+                feats.setdefault(
+                    "gids",
+                    np.clip(ids, 0, self.max_id + 1).astype(np.int32),
+                )
+            else:
+                feats["sparse"] = ops.get_sparse_feature(
+                    graph,
+                    ids,
+                    sparse_idx,
+                    self.sparse_max_len,
+                    default_values=[
+                        m + 1 for m in self.sparse_feature_max_ids
+                    ],
+                )
         return feats
 
     # ---- device state & steps ----
@@ -282,19 +305,39 @@ class Model:
             return {}
         n = self.max_id + 2
         ids = np.arange(n, dtype=np.int64)
-        consts = {
-            "features": jnp.asarray(
+        consts = {}
+        if getattr(self, "feature_idx", -1) >= 0:
+            consts["features"] = jnp.asarray(
                 graph.get_dense_feature(
                     ids, [self.feature_idx], [self.feature_dim]
                 )
             )
-        }
         if getattr(self, "label_idx", -1) >= 0:
             consts["labels"] = jnp.asarray(
                 graph.get_dense_feature(
                     ids, [self.label_idx], [self.label_dim]
                 )
             )
+        sparse_idx = getattr(self, "sparse_feature_idx", [])
+        if sparse_idx:
+            from euler_tpu import ops
+
+            tables = ops.get_sparse_feature(
+                graph,
+                ids,
+                sparse_idx,
+                self.sparse_max_len,
+                default_values=[
+                    m + 1 for m in self.sparse_feature_max_ids
+                ],
+            )
+            consts["sparse"] = [
+                {
+                    "ids": jnp.asarray(t_ids.astype(np.int32)),
+                    "mask": jnp.asarray(t_mask),
+                }
+                for t_ids, t_mask in tables
+            ]
         return consts
 
     def _apply(self, params, batch, consts, **kw):

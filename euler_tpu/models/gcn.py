@@ -304,9 +304,13 @@ class ScalableGCN(base.ScalableStoreModel):
     def build_consts(self, graph) -> dict:
         consts = super().build_consts(graph)
         if self.device_sampling:
+            # max_neighbors (the host path's per-root dense cap) bounds
+            # the slab width too: a power-law hub must not balloon every
+            # batch to B x global-max-degree
             self.add_sampling_consts(
                 consts, graph, [self.edge_type],
                 roots_type=self.train_node_type,
+                max_degree=self.max_neighbors,
             )
         return consts
 

@@ -124,13 +124,9 @@ class SupervisedGraphSage(base.Model):
     ):
         super().__init__()
         self.train_node_type = train_node_type
-        if device_sampling and sparse_feature_idx:
-            raise ValueError(
-                "device_sampling does not support sparse features (no "
-                "device-resident sparse table); use the host path"
-            )
         self.device_features = base.resolve_device_features(
-            device_features, feature_idx, max_id
+            device_features, feature_idx, max_id,
+            has_sparse=bool(sparse_feature_idx),
         )
         self.max_id = max_id
         self.init_device_sampling(device_sampling)

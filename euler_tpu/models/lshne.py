@@ -73,6 +73,12 @@ class _LsHNEModule(nn.Module):
     feature_embedding_dim: int = 16
     hidden_dim: int = 256
     gamma: float = 5.0
+    # device-sampling mode: per view, a tuple of metapaths, each a tuple
+    # of per-step consts["adj"] keys
+    view_adj_keys: Sequence = ()
+    left_win: int = 1
+    right_win: int = 1
+    default_node: int = -1
 
     def setup(self):
         self.feature_embeddings = [
@@ -145,13 +151,78 @@ class _LsHNEModule(nn.Module):
         mrr = jnp.sum(mask / rank) / jnp.maximum(jnp.sum(mask), 1.0)
         return loss, mrr
 
-    def embed(self, batch):
-        return self.att_embedding(batch["root"])
+    def _dev_node(self, ids, consts):
+        """Node-input dict gathered from the device-resident tables."""
+        t = consts["tsampler"]["types"][ids]
+        return {
+            "sparse": [
+                (tab["ids"][ids], tab["mask"][ids])
+                for tab in consts["sparse"]
+            ],
+            "types": jnp.clip(t, 0, None),
+        }
 
-    def __call__(self, batch):
+    def _device_views(self, batch, consts):
+        """(views, root) built inside jit: metapath walks -> skip-gram
+        pairs per view, typed negatives per source — the device analog of
+        LsHNE.sample."""
+        import jax
+
+        from euler_tpu import ops as _ops
+        from euler_tpu.graph import device as device_graph
+
+        roots = batch["roots"]
+        key = jax.random.PRNGKey(batch["seed"][0])
+        views = []
+        for v, patterns in enumerate(self.view_adj_keys):
+            kv = jax.random.fold_in(key, v)
+            srcs, poss = [], []
+            for pi, step_keys in enumerate(patterns):
+                adjs = [consts["adj"][k] for k in step_keys]
+                paths = device_graph.random_walk(
+                    adjs, roots, jax.random.fold_in(kv, pi),
+                    len(step_keys),
+                )
+                ti, ci = _ops.walk.pair_indices(
+                    len(step_keys) + 1, self.left_win, self.right_win
+                )
+                srcs.append(paths[:, ti])
+                poss.append(paths[:, ci])
+            src = jnp.concatenate(srcs, axis=1).reshape(-1)
+            pos = jnp.concatenate(poss, axis=1).reshape(-1)
+            mask = (
+                (src != self.default_node) & (pos != self.default_node)
+            ).astype(jnp.float32)
+            safe_src = jnp.where(src == self.default_node, 0, src)
+            negs = device_graph.sample_node_with_src(
+                consts["tsampler"], safe_src,
+                jax.random.fold_in(kv, 1 << 20), self.num_negs,
+            ).reshape(-1)
+            views.append(
+                {
+                    "src": self._dev_node(src, consts),
+                    "pos": self._dev_node(pos, consts),
+                    "negs": self._dev_node(negs, consts),
+                    "mask": mask,
+                }
+            )
+        return views, self._dev_node(roots, consts)
+
+    def _views_and_root(self, batch, consts):
+        if "views" in batch:
+            return batch["views"], batch["root"]
+        return self._device_views(batch, consts)
+
+    def embed(self, batch, consts=None):
+        if "root" in batch:
+            return self.att_embedding(batch["root"])
+        return self.att_embedding(self._dev_node(batch["roots"], consts))
+
+    def __call__(self, batch, consts=None):
+        views, root = self._views_and_root(batch, consts)
         total = 0.0
         mrrs = []
-        for v, view in enumerate(batch["views"]):
+        for v, view in enumerate(views):
             emb = self.encode_src(view["src"], v)
             emb_pos = self.encode_tar(view["pos"])
             B = emb.shape[0]
@@ -167,7 +238,7 @@ class _LsHNEModule(nn.Module):
             loss_att, mrr = self._decode(emb_att, emb_pos, emb_negs, mask)
             total = total + loss_v + loss_att
             mrrs.append(mrr)
-        embedding = self.att_embedding(batch["root"])
+        embedding = self.att_embedding(root)
         return base.ModelOutput(
             embedding=embedding,
             loss=total,
@@ -198,6 +269,7 @@ class LsHNE(base.Model):
         num_negs: int = 5,
         gamma: float = 5.0,
         src_type_num: int = 20,
+        device_sampling: bool = False,
     ):
         super().__init__()
         if len(path_patterns) < 1:
@@ -205,6 +277,8 @@ class LsHNE(base.Model):
         self.node_type = node_type
         self.path_patterns = path_patterns
         self.max_id = max_id
+        self.init_device_sampling(device_sampling, require_features=False)
+        self.src_type_num = src_type_num
         self.walk_len = walk_len
         self.left_win_size = left_win_size
         self.right_win_size = right_win_size
@@ -212,6 +286,16 @@ class LsHNE(base.Model):
         self.feature_ids = list(feature_ids)
         self.sparse_max_len = sparse_max_len
         self.gamma = gamma
+        # per view, per metapath: one adj key per STEP — the host walk's
+        # metapath semantics (walk length = len(pattern), each step
+        # restricted to its own edge-type set)
+        self._view_adj_keys = tuple(
+            tuple(
+                tuple(self.adj_key(step) for step in pattern)
+                for pattern in patterns
+            )
+            for patterns in path_patterns
+        )
         self.module = _LsHNEModule(
             view_num=len(path_patterns),
             dim=dim,
@@ -220,7 +304,43 @@ class LsHNE(base.Model):
             sparse_feature_dims=tuple(sparse_feature_dims),
             feature_embedding_dim=feature_embedding_dim,
             gamma=gamma,
+            view_adj_keys=self._view_adj_keys,
+            left_win=left_win_size,
+            right_win=right_win_size,
+            default_node=max_id + 1,
         )
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if not self.device_sampling:
+            return consts
+        from euler_tpu.graph import device as device_graph
+
+        step_sets = [
+            step
+            for patterns in self.path_patterns
+            for pattern in patterns
+            for step in pattern
+        ]
+        self.add_sampling_consts(
+            consts, graph, step_sets, roots_type=self.node_type
+        )
+        consts["tsampler"] = device_graph.build_typed_node_sampler(
+            graph, self.src_type_num, self.max_id
+        )
+        all_ids = np.arange(self.max_id + 2, dtype=np.int64)
+        tables = ops.get_sparse_feature(
+            graph, all_ids, self.feature_ids, self.sparse_max_len,
+            default_values=[0] * len(self.feature_ids),
+        )
+        consts["sparse"] = [
+            {
+                "ids": t_ids.astype(np.int32),
+                "mask": t_mask,
+            }
+            for t_ids, t_mask in tables
+        ]
+        return consts
 
     def _node_inputs(self, graph, ids: np.ndarray) -> dict:
         ids = ids.reshape(-1)
@@ -236,6 +356,8 @@ class LsHNE(base.Model):
 
     def sample(self, graph, inputs) -> dict:
         roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(roots)
         views = []
         for patterns in self.path_patterns:
             pair_list = []

@@ -36,6 +36,7 @@ class _ShallowUnsupModule(nn.Module):
     left_win: int = 0
     right_win: int = 0
     has_features: bool = False
+    has_sparse: bool = False
 
     def setup(self):
         kw = dict(
@@ -57,7 +58,7 @@ class _ShallowUnsupModule(nn.Module):
         f = {}
         if self.max_id >= 0:
             f["ids"] = ids
-        if self.has_features:
+        if self.has_features or self.has_sparse:
             f["gids"] = ids
         return f
 
@@ -134,10 +135,6 @@ class _ShallowUnsupervised(base.Model):
         device_sampling: bool = False,
     ):
         super().__init__()
-        if device_sampling and sparse_feature_idx:
-            raise ValueError(
-                "device_sampling does not support sparse features"
-            )
         self.node_type = node_type
         self.max_id = max_id
         self.feature_idx = feature_idx
@@ -148,15 +145,18 @@ class _ShallowUnsupervised(base.Model):
         self.sparse_max_len = sparse_max_len
         self.num_negs = num_negs
         self.device_features = base.resolve_device_features(
-            device_features, feature_idx, max_id
+            device_features, feature_idx, max_id,
+            has_sparse=bool(sparse_feature_idx),
         )
         # the id-embedding path needs no feature table: device_sampling
         # composes with use_id alone (device_features only required when
         # dense features are configured)
-        if device_sampling and feature_idx >= 0 and not self.device_features:
+        if device_sampling and not self.device_features and (
+            feature_idx >= 0 or sparse_feature_idx
+        ):
             raise ValueError(
-                "device_sampling with dense features requires "
-                "device_features=True"
+                "device_sampling with dense/sparse features requires "
+                "device_features=True (the tables must be HBM-resident)"
             )
         self.init_device_sampling(device_sampling, require_features=False)
 
@@ -212,7 +212,9 @@ class LINE(_ShallowUnsupervised):
             num_negs=self.num_negs,
             share_context=order in (1, "first"),
             adj_key=self.adj_key(self.edge_type),
-            has_features=self.device_features,
+            has_features=self.device_features and self.feature_idx >= 0,
+            has_sparse=self.device_features
+            and bool(self.sparse_feature_idx),
         )
 
     def sample(self, graph, inputs) -> dict:
@@ -280,7 +282,9 @@ class Node2Vec(_ShallowUnsupervised):
             walk_len=walk_len,
             left_win=left_win_size,
             right_win=right_win_size,
-            has_features=self.device_features,
+            has_features=self.device_features and self.feature_idx >= 0,
+            has_sparse=self.device_features
+            and bool(self.sparse_feature_idx),
         )
 
     def sample(self, graph, inputs) -> dict:

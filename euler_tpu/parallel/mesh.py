@@ -124,7 +124,7 @@ def _is_table(path, x) -> bool:
         return False
     if name == "consts" and len(path) > 1:
         sub = getattr(path[1], "key", getattr(path[1], "idx", None))
-        if sub not in ("features", "labels"):
+        if sub not in ("features", "labels", "sparse"):
             return False
     return True
 

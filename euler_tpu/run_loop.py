@@ -105,10 +105,11 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "--device_sampling", type=_str2bool, default=False,
         help="also keep the ADJACENCY HBM-resident and sample fanouts/"
              "walks inside the jitted step (graphsage, "
-             "graphsage_supervised, scalable_sage, gat, line, node2vec "
-             "with p=q=1); the host ships only root ids per step. For "
-             "feature models this implies --device_features; the shallow "
-             "id-embedding models run it standalone",
+             "graphsage_supervised, scalable_sage, scalable_gcn, gat, "
+             "line, node2vec with p=q=1, lshne); the host ships only "
+             "root ids per step. For feature models this implies "
+             "--device_features; the shallow id-embedding models run it "
+             "standalone",
     )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
@@ -471,11 +472,15 @@ def build_model(args, graph):
     if name == "lshne":
         return models.LsHNE(
             node_type=-1,
-            path_patterns=[[[0, 0, 0], [0, 0, 0]]],
+            # one view, two 3-step homogeneous metapaths (per-step
+            # edge-type LISTS — a flat [0,0,0] would be rejected by the
+            # walk's metapath parser)
+            path_patterns=[[[[0], [0], [0]], [[0], [0], [0]]]],
             max_id=args.max_id,
             dim=128,
             sparse_feature_dims=[args.max_id + 2],
             feature_ids=[args.feature_idx if args.feature_idx >= 0 else 0],
+            device_sampling=args.device_sampling,
         )
     if name == "saved_embedding":
         emb = np.load(

@@ -108,6 +108,95 @@ def test_fanout_chains_through_dead_ends(graph, adj01):
             assert (h2[i] == MAX_ID + 1).all()
 
 
+def test_metapath_walk_respects_step_types(graph):
+    """A heterogeneous walk alternating type-0 and type-1 adjacencies must
+    only traverse edges of the step's type (device analog of the host
+    metapath random_walk)."""
+    import jax
+
+    adj0 = device.build_adjacency(graph, [0], MAX_ID)
+    adj1 = device.build_adjacency(graph, [1], MAX_ID)
+    roots = graph.sample_node(32, 0)
+    paths = np.asarray(
+        device.random_walk(
+            [adj0, adj1], roots, jax.random.PRNGKey(0), 2
+        )
+    )
+    default = MAX_ID + 1
+    for row in paths:
+        a, b, c = row
+        if b != default:
+            nbr, _, _, _ = graph.get_full_neighbor([a], [0])
+            assert b in nbr
+        if c != default:
+            nbr, _, _, _ = graph.get_full_neighbor([b], [1])
+            assert c in nbr
+
+
+def test_typed_negatives_match_src_type(graph, meta):
+    """Each source's negatives come from its OWN node type's weighted
+    sampler (native sample_node_with_src semantics), with the right
+    marginal distribution."""
+    import jax
+
+    ts = device.build_typed_node_sampler(
+        graph, meta["node_type_num"], MAX_ID
+    )
+    src = graph.sample_node(64, -1)
+    negs = np.asarray(
+        device.sample_node_with_src(ts, src, jax.random.PRNGKey(0), 50)
+    )
+    src_types = graph.node_types(src)
+    for i in range(len(src)):
+        assert (graph.node_types(negs[i]) == src_types[i]).all()
+    # distribution within one type follows node weights
+    t0 = np.flatnonzero(src_types == 0)
+    draws = negs[t0].reshape(-1)
+    ids = np.arange(MAX_ID + 1)
+    w = graph.node_weights(ids)
+    w[graph.node_types(ids) != 0] = 0
+    probs = w / w.sum()
+    for i in ids[w > 0]:
+        assert abs((draws == i).mean() - probs[i]) < 0.03
+
+
+def test_typed_negatives_clamp_out_of_range_types(graph):
+    """Sources whose node type is outside the sampler's configured range
+    clamp into it (like the TypedDense towers) — never the degenerate
+    all-default-negatives path."""
+    import jax
+
+    ts = device.build_typed_node_sampler(graph, 1, MAX_ID)  # only type 0
+    src = graph.sample_node(16, 1)  # type-1 sources
+    negs = np.asarray(
+        device.sample_node_with_src(ts, src, jax.random.PRNGKey(0), 8)
+    )
+    assert (negs != MAX_ID + 1).all()  # real nodes, not the default
+    assert (graph.node_types(negs.reshape(-1)) == 0).all()
+
+
+def test_device_sparse_tables_match_host_gather(graph):
+    """consts['sparse'] rows gathered at gids must equal the host-side
+    padded sparse gather for the same nodes."""
+    from euler_tpu import ops
+    from euler_tpu.models import SupervisedGraphSage
+    from euler_tpu.models.base import gather_consts
+
+    m = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1]], fanouts=[3],
+        dim=16, feature_idx=0, feature_dim=2, max_id=MAX_ID,
+        sparse_feature_idx=[0], sparse_feature_max_ids=[40],
+        sparse_max_len=4, device_features=True,
+    )
+    consts = m.build_consts(graph)
+    ids = np.arange(MAX_ID + 1, dtype=np.int64)
+    host = ops.get_sparse_feature(graph, ids, [0], 4, default_values=[41])
+    feats = gather_consts({"gids": ids.astype(np.int32)}, consts)
+    dev_ids, dev_mask = feats["sparse"][0]
+    np.testing.assert_array_equal(np.asarray(dev_ids), host[0][0])
+    np.testing.assert_array_equal(np.asarray(dev_mask), host[0][1])
+
+
 def test_zero_weight_neighbors_exist_but_never_sample(tmp_path):
     """A node whose edges all weigh 0: the host engine returns the
     neighbors from GetFullNeighbor (they EXIST — the full-neighborhood
@@ -318,19 +407,28 @@ def test_device_sampling_with_use_id(graph):
     state, loss, _ = step(state, m.sample(graph, graph.sample_node(8, -1)))
     assert np.isfinite(float(loss))
 
-    with pytest.raises(ValueError, match="sparse"):
-        SupervisedGraphSage(
-            label_idx=2, label_dim=3, metapath=[[0, 1]], fanouts=[3],
-            dim=16, feature_idx=0, feature_dim=2, max_id=MAX_ID,
-            sparse_feature_idx=[0], sparse_feature_max_ids=[5],
-            device_features=True, device_sampling=True,
-        )
+    # sparse features ride device-resident padded tables (consts["sparse"])
+    m2 = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1]], fanouts=[3],
+        dim=16, feature_idx=0, feature_dim=2, max_id=MAX_ID,
+        sparse_feature_idx=[0], sparse_feature_max_ids=[40],
+        device_features=True, device_sampling=True,
+    )
+    state = m2.init_state(
+        jax.random.PRNGKey(0), graph, graph.sample_node(8, -1), opt
+    )
+    assert "sparse" in state["consts"]
+    step = jax.jit(m2.make_train_step(opt), donate_argnums=(0,))
+    state, loss, _ = step(
+        state, m2.sample(graph, graph.sample_node(8, -1))
+    )
+    assert np.isfinite(float(loss))
 
 
 @pytest.mark.parametrize(
     "family",
     ["unsup_sage", "gat", "scalable_sage", "scalable_gcn", "line",
-     "node2vec"],
+     "node2vec", "lshne"],
 )
 def test_device_sampling_model_families(graph, family):
     """device_sampling generalizes across families: unsupervised GraphSAGE
@@ -366,6 +464,17 @@ def test_device_sampling_model_families(graph, family):
         m = models.Node2Vec(
             node_type=-1, edge_type=[0, 1], max_id=MAX_ID, dim=16,
             walk_len=3, left_win_size=1, right_win_size=1, num_negs=3,
+            device_sampling=True,
+        )
+    elif family == "lshne":
+        m = models.LsHNE(
+            node_type=-1,
+            path_patterns=[
+                [[[0], [1], [0]]],
+                [[[0, 1], [0, 1], [0, 1]]],
+            ],
+            max_id=MAX_ID, dim=8, sparse_feature_dims=[32, 32],
+            feature_ids=[0, 1], num_negs=4, src_type_num=2,
             device_sampling=True,
         )
     elif family == "scalable_gcn":
