@@ -264,6 +264,7 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
     ids_out: list[np.ndarray] = []
     cum_out: list[np.ndarray] = []
     off = [0]
+    empty_types = []
     for t in range(num_types):
         mask = (types == t) & (weights > 0)
         tids = all_ids[mask]
@@ -273,9 +274,29 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
             c /= c[-1]
         else:
             c = np.zeros(0)
+            if (types == t).any():
+                empty_types.append(t)
+        if len(tids) > (1 << 24):
+            import warnings
+
+            warnings.warn(
+                f"build_typed_node_sampler: type {t} has {len(tids)} "
+                "nodes, beyond float32 cumulative-weight resolution "
+                "(~16M); tail nodes may be unsampleable — use host-side "
+                "negative sampling for graphs this large"
+            )
         ids_out.append(tids)
         cum_out.append(c)
         off.append(off[-1] + len(tids))
+    if empty_types:
+        import warnings
+
+        warnings.warn(
+            f"build_typed_node_sampler: node types {empty_types} exist "
+            "but have no weight>0 nodes; sources of these types will "
+            "draw the default (zero-feature) node as negatives — give "
+            "those nodes sampling weight or use host-side negatives"
+        )
     ids_cat = (
         np.concatenate(ids_out) if off[-1] else np.zeros(0, np.int64)
     )
@@ -333,6 +354,11 @@ def sample_fanout(adjs, roots, key, counts):
     homogeneous metapath). Returns [roots, hop1, hop2, ...] flat id
     arrays, hop h sized prod(counts[:h+1]) * len(roots).
     """
+    if len(adjs) != len(counts):
+        raise ValueError(
+            f"sample_fanout needs one adjacency per hop: got {len(adjs)} "
+            f"adjacencies for {len(counts)} fanout counts"
+        )
     roots = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
     out = [roots]
     cur = roots

@@ -94,6 +94,29 @@ def shared_negs_decoder(emb, emb_pos, emb_negs, xent_loss: bool):
     return loss, mrr
 
 
+def upload_sparse_tables(
+    graph, max_id: int, feature_idxs, max_len: int, default_values
+) -> list:
+    """Padded sparse-feature tables for every node (rows 0..max_id+1, row
+    max_id+1 = default/padding), as device arrays ready for
+    state['consts'] — one {'ids', 'mask'} dict per feature slot. Shared
+    by every model family that gathers sparse features on device."""
+    from euler_tpu import ops
+
+    all_ids = np.arange(max_id + 2, dtype=np.int64)
+    tables = ops.get_sparse_feature(
+        graph, all_ids, list(feature_idxs), max_len,
+        default_values=list(default_values),
+    )
+    return [
+        {
+            "ids": jnp.asarray(t_ids.astype(np.int32)),
+            "mask": jnp.asarray(t_mask),
+        }
+        for t_ids, t_mask in tables
+    ]
+
+
 def gather_consts(feats: dict, consts: dict) -> dict:
     """Materialize device-resident features for one node set: replace the
     host-side 'gids' indices with gathers from the HBM-resident tables
@@ -320,24 +343,10 @@ class Model:
             )
         sparse_idx = getattr(self, "sparse_feature_idx", [])
         if sparse_idx:
-            from euler_tpu import ops
-
-            tables = ops.get_sparse_feature(
-                graph,
-                ids,
-                sparse_idx,
-                self.sparse_max_len,
-                default_values=[
-                    m + 1 for m in self.sparse_feature_max_ids
-                ],
+            consts["sparse"] = upload_sparse_tables(
+                graph, self.max_id, sparse_idx, self.sparse_max_len,
+                [m + 1 for m in self.sparse_feature_max_ids],
             )
-            consts["sparse"] = [
-                {
-                    "ids": jnp.asarray(t_ids.astype(np.int32)),
-                    "mask": jnp.asarray(t_mask),
-                }
-                for t_ids, t_mask in tables
-            ]
         return consts
 
     def _apply(self, params, batch, consts, **kw):

@@ -65,6 +65,9 @@ class _LasGNNModule(nn.Module):
     feature_dims: Sequence[int]
     aggregator: str = "mean"
     concat: bool = False
+    # device-sampling mode: per group, per metapath, per-hop keys into
+    # consts["adj"] (the heterogeneous fanout runs inside the jitted step)
+    group_adj_keys: Sequence = ()
 
     def setup(self):
         # Shared sparse embeddings across all towers (reference
@@ -98,7 +101,49 @@ class _LasGNNModule(nn.Module):
             out.append(jnp.concatenate(embs, axis=-1))
         return out
 
-    def group_embeddings(self, batch):
+    def _device_groups(self, batch, consts, only_target: bool = False):
+        """The per-group/per-metapath hop structure built inside jit:
+        heterogeneous fanouts over the HBM-resident adjacency slabs, hop
+        features gathered from the consts sparse tables — the device
+        analog of LasGNN.sample."""
+        import jax
+
+        from euler_tpu.graph import device as device_graph
+
+        key = jax.random.PRNGKey(batch["seed"][0])
+        groups = []
+        n_groups = 1 if only_target else len(self.group_adj_keys)
+        for g in range(n_groups):
+            flat = batch[f"group{g}"].reshape(-1)
+            per_metapath = []
+            for m, hop_keys in enumerate(self.group_adj_keys[g]):
+                adjs = [consts["adj"][k] for k in hop_keys]
+                ids = device_graph.sample_fanout(
+                    adjs, flat, jax.random.fold_in(key, (g << 8) | m),
+                    list(self.fanouts),
+                )
+                per_metapath.append(
+                    {
+                        "hops": [
+                            {
+                                "sparse": [
+                                    (tab["ids"][h], tab["mask"][h])
+                                    for tab in consts["sparse"]
+                                ]
+                            }
+                            for h in ids
+                        ]
+                    }
+                )
+            groups.append(per_metapath)
+        return groups
+
+    def _groups(self, batch, consts, only_target: bool = False):
+        if "groups" in batch:
+            return batch["groups"]
+        return self._device_groups(batch, consts, only_target)
+
+    def group_embeddings(self, groups):
         """Per group: [B, n_g * dim] after metapath attention + flatten
         (reference lasgnn.py:130-140)."""
         outs = []
@@ -107,7 +152,7 @@ class _LasGNNModule(nn.Module):
         ):
             per_metapath = []
             for m, tower in enumerate(towers):
-                hops = self._embed_hops(batch["groups"][g][m]["hops"])
+                hops = self._embed_hops(groups[g][m]["hops"])
                 emb = tower(hops)  # [B*n_g, dim]
                 per_metapath.append(emb.reshape(-1, n_g, emb.shape[-1]))
             stack = jnp.stack(per_metapath, axis=-2)  # [B, n_g, M, dim]
@@ -115,21 +160,22 @@ class _LasGNNModule(nn.Module):
             outs.append(combined.reshape(combined.shape[0], -1))
         return outs
 
-    def embed(self, batch):
+    def embed(self, batch, consts=None):
         """Target-group embedding only — context towers are not computed
         (batch may contain just the target group)."""
+        groups = self._groups(batch, consts, only_target=True)
         per_metapath = []
         n_g = self.group_sizes[0]
         for m, tower in enumerate(self.towers[0]):
-            hops = self._embed_hops(batch["groups"][0][m]["hops"])
+            hops = self._embed_hops(groups[0][m]["hops"])
             emb = tower(hops)
             per_metapath.append(emb.reshape(-1, n_g, emb.shape[-1]))
         stack = jnp.stack(per_metapath, axis=-2)
         combined = self.attentions[0](stack)
         return self.target_ff(combined.reshape(combined.shape[0], -1))
 
-    def __call__(self, batch):
-        groups = self.group_embeddings(batch)
+    def __call__(self, batch, consts=None):
+        groups = self.group_embeddings(self._groups(batch, consts))
         target = self.target_ff(groups[0])
         context = self.context_ff(jnp.concatenate(groups[1:], axis=-1))
         # sqrt(x + eps) keeps gradients finite for exactly-zero embeddings.
@@ -172,6 +218,7 @@ class LasGNN(base.Model):
         aggregator: str = "mean",
         concat: bool = False,
         sparse_max_len: int = 16,
+        device_sampling: bool = False,
     ):
         super().__init__()
         self.metapaths_of_groups = metapaths_of_groups
@@ -181,6 +228,17 @@ class LasGNN(base.Model):
         self.group_sizes = list(group_sizes)
         self.max_id = max_id
         self.sparse_max_len = sparse_max_len
+        self.init_device_sampling(device_sampling, require_features=False)
+        # per group, per metapath: one consts["adj"] key per HOP (each hop
+        # restricted to its own edge-type set — the host sample_fanout's
+        # heterogeneous metapath semantics)
+        self._group_adj_keys = tuple(
+            tuple(
+                tuple(self.adj_key(hop) for hop in metapath)
+                for metapath in metapaths
+            )
+            for metapaths in metapaths_of_groups
+        )
         self.module = _LasGNNModule(
             metapath_counts=tuple(len(m) for m in metapaths_of_groups),
             group_sizes=tuple(group_sizes),
@@ -189,7 +247,27 @@ class LasGNN(base.Model):
             feature_dims=tuple(feature_dims),
             aggregator=aggregator,
             concat=concat,
+            group_adj_keys=(
+                self._group_adj_keys if self.device_sampling else ()
+            ),
         )
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if not self.device_sampling:
+            return consts
+        hop_sets = [
+            hop
+            for metapaths in self.metapaths_of_groups
+            for metapath in metapaths
+            for hop in metapath
+        ]
+        self.add_sampling_consts(consts, graph, hop_sets)
+        consts["sparse"] = base.upload_sparse_tables(
+            graph, self.max_id, self.feature_ixs, self.sparse_max_len,
+            [d + 1 for d in self.feature_dims],
+        )
+        return consts
 
     def _hop_inputs(self, graph, ids: np.ndarray) -> dict:
         return {
@@ -204,6 +282,20 @@ class LasGNN(base.Model):
 
     def sample(self, graph, inputs) -> dict:
         label = np.asarray(inputs["label"], dtype=np.float32).reshape(-1, 1)
+        if self.device_sampling:
+            # host ships only labels + per-group node ids + a seed; the
+            # heterogeneous fanouts and sparse-feature gathers happen
+            # inside the jitted step against the HBM-resident slabs
+            batch = {"label": label}
+            for g, group_ids in enumerate(inputs["groups"]):
+                ids = np.asarray(group_ids, dtype=np.int64)
+                batch[f"group{g}"] = np.clip(
+                    ids, 0, self.max_id + 1
+                ).astype(np.int32)
+            batch["seed"] = np.full(
+                len(label), next(self._sample_seed), np.int32
+            )
+            return batch
         groups = []
         for g, (group_ids, metapaths) in enumerate(
             zip(inputs["groups"], self.metapaths_of_groups)
@@ -228,6 +320,15 @@ class LasGNN(base.Model):
     def sample_embed(self, graph, inputs) -> dict:
         """Target group only — no context sampling for embedding export."""
         ids = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return {
+                "group0": np.clip(ids, 0, self.max_id + 1)
+                .astype(np.int32)
+                .reshape(-1, self.group_sizes[0]),
+                "seed": np.full(
+                    len(ids), next(self._sample_seed), np.int32
+                ),
+            }
         per_metapath = []
         for metapath in self.metapaths_of_groups[0]:
             ids_per_hop, _, _ = graph.sample_fanout(

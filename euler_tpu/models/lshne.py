@@ -328,18 +328,10 @@ class LsHNE(base.Model):
         consts["tsampler"] = device_graph.build_typed_node_sampler(
             graph, self.src_type_num, self.max_id
         )
-        all_ids = np.arange(self.max_id + 2, dtype=np.int64)
-        tables = ops.get_sparse_feature(
-            graph, all_ids, self.feature_ids, self.sparse_max_len,
-            default_values=[0] * len(self.feature_ids),
+        consts["sparse"] = base.upload_sparse_tables(
+            graph, self.max_id, self.feature_ids, self.sparse_max_len,
+            [0] * len(self.feature_ids),
         )
-        consts["sparse"] = [
-            {
-                "ids": t_ids.astype(np.int32),
-                "mask": t_mask,
-            }
-            for t_ids, t_mask in tables
-        ]
         return consts
 
     def _node_inputs(self, graph, ids: np.ndarray) -> dict:

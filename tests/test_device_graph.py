@@ -511,6 +511,52 @@ def test_device_sampling_model_families(graph, family):
     assert np.isfinite(np.asarray(losses)).all()
 
 
+def test_lasgnn_device_sampling_trains(graph):
+    """LasGNN's structured batch (label + node-id groups) also runs the
+    device path: host ships only labels/ids/seed, the per-group
+    heterogeneous metapath fanouts and sparse-feature gathers happen
+    inside the jitted step."""
+    from euler_tpu import models
+    from euler_tpu import train as train_lib
+
+    m = models.LasGNN(
+        metapaths_of_groups=[
+            [[[0], [0, 1]]],
+            [[[0], [0, 1]], [[1], [0, 1]]],
+        ],
+        fanouts=[2, 2],
+        dim=8,
+        feature_ixs=[0, 1],
+        feature_dims=[32, 32],
+        group_sizes=[1, 2],
+        max_id=MAX_ID,
+        device_sampling=True,
+    )
+    rng = np.random.default_rng(0)
+
+    def source_fn(step):
+        ids = graph.sample_node(8, -1)
+        ctx = graph.sample_node(16, -1).reshape(8, 2)
+        return {
+            "label": rng.integers(0, 2, (8, 1)).astype(np.float32),
+            "groups": [ids.reshape(8, 1), ctx],
+        }
+
+    batch = m.sample(graph, source_fn(0))
+    assert set(batch) == {"label", "group0", "group1", "seed"}
+    assert batch["group1"].dtype == np.int32
+
+    state, hist = train_lib.train(
+        m, graph, source_fn, num_steps=6, learning_rate=0.01,
+        log_every=3,
+    )
+    assert np.isfinite(hist[-1]["loss"])
+    assert 0.0 <= hist[-1]["auc"] <= 1.0
+    emb = train_lib.save_embedding(m, graph, MAX_ID, state, batch_size=8)
+    assert emb.shape == (MAX_ID + 1, 8)
+    assert np.isfinite(emb).all()
+
+
 def test_remote_graph_rejected(graph, tmp_path):
     from euler_tpu.graph.service import GraphService
     import euler_tpu
