@@ -152,6 +152,21 @@ def build_adjacency(
     }
 
 
+def _warn_float32_cum_resolution(n: int, where: str, kind: str) -> None:
+    """Device arrays are float32 (jax x32): beyond ~16M comparably-
+    weighted nodes, adjacent cumulative values collide at float32
+    resolution and the colliding nodes silently get probability 0.
+    (Adjacency rows never hit this: W stays small.)"""
+    if n > (1 << 24):
+        import warnings
+
+        warnings.warn(
+            f"{where}: {n} nodes exceeds float32 cumulative-weight "
+            f"resolution (~16M); tail nodes may be unsampleable — use "
+            f"host-side {kind} sampling for graphs this large"
+        )
+
+
 def build_node_sampler(graph, node_type: int = -1, max_id: int = 0) -> dict:
     """Weighted global root sampler for one node type (-1 = all types,
     type picked by weight sum first — reference compact_graph.cc:32-56;
@@ -170,19 +185,7 @@ def build_node_sampler(graph, node_type: int = -1, max_id: int = 0) -> dict:
     ids, weights = ids[keep], weights[keep]
     if len(ids) == 0:
         raise ValueError(f"no nodes of type {node_type} with weight > 0")
-    if len(ids) > (1 << 24):
-        # device arrays are float32 (jax x32): beyond ~16M comparably-
-        # weighted nodes, adjacent cumulative values collide at float32
-        # resolution and the colliding nodes silently get probability 0.
-        # (Adjacency rows never hit this: W stays small.)
-        import warnings
-
-        warnings.warn(
-            f"build_node_sampler: {len(ids)} nodes exceeds float32 "
-            "cumulative-weight resolution (~16M); tail nodes may be "
-            "unsampleable — use host-side root sampling for graphs "
-            "this large"
-        )
+    _warn_float32_cum_resolution(len(ids), "build_node_sampler", "root")
     cum = np.cumsum(weights.astype(np.float64))
     cum /= cum[-1]
     return {"ids": ids.astype(np.int32), "cum": cum.astype(np.float32)}
@@ -276,15 +279,9 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
             c = np.zeros(0)
             if (types == t).any():
                 empty_types.append(t)
-        if len(tids) > (1 << 24):
-            import warnings
-
-            warnings.warn(
-                f"build_typed_node_sampler: type {t} has {len(tids)} "
-                "nodes, beyond float32 cumulative-weight resolution "
-                "(~16M); tail nodes may be unsampleable — use host-side "
-                "negative sampling for graphs this large"
-            )
+        _warn_float32_cum_resolution(
+            len(tids), f"build_typed_node_sampler (type {t})", "negative"
+        )
         ids_out.append(tids)
         cum_out.append(c)
         off.append(off[-1] + len(tids))
@@ -345,6 +342,72 @@ def sample_node_with_src(tsampler: dict, src, key, count: int):
     out = tsampler["ids"][idx]
     default = tsampler["types"].shape[0] - 1
     return jnp.where(empty, default, out)
+
+
+def multi_hop_neighbor(adjs, roots, node_caps):
+    """Full-neighbor multi-hop expansion with per-hop dedup, inside jit
+    (device analog of ops.get_multi_hop_neighbor; deterministic — no
+    sampling, no RNG).
+
+    Per hop: gather every current node's full slab row, dedup the
+    neighbor ids with a sort-based dense-rank (jnp.unique's size=
+    truncation leaves inverse indices unspecified, so rank is computed
+    explicitly), and emit the same padded COO the host path produces —
+    {"nodes": [cap] (default-padded, sorted like np.unique),
+    "src"/"dst": [C*W] indices into the current/next hop arrays,
+    "mask": [C*W] 1.0 on real edges, "w": alias of mask (the sparse
+    aggregators use binary adjacency)}.
+
+    Divergences from the host path, both graceful where the host raises:
+    rows beyond the slab's max_degree were already truncated to their
+    heaviest neighbors at build_adjacency time, and a hop with more than
+    node_caps[h] unique neighbors drops the largest-id overflow nodes
+    (their edges are masked out) instead of raising — caps must be sized
+    generously, exactly like the host's max_nodes_per_hop.
+    """
+    cur = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
+    hops = []
+    for adj, cap in zip(adjs, node_caps):
+        default = adj["nbr"].shape[0] - 1
+        W = adj["nbr"].shape[1]
+        C = cur.shape[0]
+        nbrs = adj["nbr"][cur]                            # [C, W]
+        valid = jnp.arange(W)[None, :] < adj["deg"][cur][:, None]
+        flat = jnp.where(valid, nbrs, default).reshape(-1)  # [C*W]
+        # sort-based dedup: dense rank of each flat entry among the
+        # sorted unique ids. The default node is the largest id, so
+        # padding entries sort last and never displace real nodes.
+        order = jnp.argsort(flat)
+        s = flat[order]
+        first = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), s[1:] != s[:-1]]
+        )
+        rank_sorted = jnp.cumsum(first) - 1               # [C*W]
+        rank = jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
+        # overflow ranks (>= cap) scatter out of bounds and are dropped
+        nodes = (
+            jnp.full((cap,), default, dtype=jnp.int32)
+            .at[rank_sorted]
+            .set(s.astype(jnp.int32), mode="drop")
+        )
+        src = jnp.repeat(jnp.arange(C, dtype=jnp.int32), W)
+        dst = jnp.clip(rank, 0, cap - 1).astype(jnp.int32)
+        mask = (
+            valid.reshape(-1)
+            & (rank < cap)
+            & (flat != default)
+        ).astype(jnp.float32)
+        hops.append(
+            {
+                "nodes": nodes,
+                "src": src,
+                "dst": dst,
+                "mask": mask,
+                "w": mask,
+            }
+        )
+        cur = nodes
+    return hops
 
 
 def sample_fanout(adjs, roots, key, counts):

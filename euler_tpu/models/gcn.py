@@ -40,6 +40,11 @@ class _SupervisedGCNModule(nn.Module):
     max_id: int = -1
     embedding_dim: int = 16
     sparse_feature_max_ids: Sequence[int] = ()
+    # device-sampling mode: per-hop keys into consts["adj"] + static
+    # unique-node caps (the full-neighbor expansion is deterministic, so
+    # "sampling" here is just the on-device multi-hop dedup)
+    hop_adj_keys: Sequence[str] = ()
+    node_caps: Sequence[int] = ()
 
     def setup(self):
         self.node_encoder = ShallowEncoder(
@@ -58,17 +63,39 @@ class _SupervisedGCNModule(nn.Module):
         )
         self.predict = nn.Dense(self.num_classes)
 
-    def embed(self, batch, consts=None):
+    def _hops_adjs(self, batch, consts):
+        """(hop feature dicts, adjacency dicts): host-built ("hops" +
+        "adjs") or expanded HERE on device from the HBM-resident slabs
+        ("roots")."""
+        if "hops" in batch:
+            return batch["hops"], batch["adjs"]
+        from euler_tpu.graph import device as device_graph
+
+        adjs = [consts["adj"][k] for k in self.hop_adj_keys]
+        hops = device_graph.multi_hop_neighbor(
+            adjs, batch["roots"], list(self.node_caps)
+        )
+        node_sets = [batch["roots"]] + [h["nodes"] for h in hops]
+        if self.max_id >= 0:  # use_id: the gids double as embedding ids
+            feats = [{"gids": i, "ids": i} for i in node_sets]
+        else:
+            feats = [{"gids": i} for i in node_sets]
+        return feats, hops
+
+    def _forward(self, batch, consts):
+        hops, adjs = self._hops_adjs(batch, consts)
         hidden = [
-            self.node_encoder(base.gather_consts(f, consts))
-            for f in batch["hops"]
+            self.node_encoder(base.gather_consts(f, consts)) for f in hops
         ]
-        return self.encoder(hidden, batch["adjs"])
+        return self.encoder(hidden, adjs), hops
+
+    def embed(self, batch, consts=None):
+        return self._forward(batch, consts)[0]
 
     def __call__(self, batch, consts=None):
-        embedding = self.embed(batch, consts)
+        embedding, hops = self._forward(batch, consts)
         logits = self.predict(embedding)
-        labels = base.lookup_labels(batch, consts, batch["hops"][0].get("gids"))
+        labels = base.lookup_labels(batch, consts, hops[0].get("gids"))
         loss, predictions = base.supervised_decoder(
             logits, labels, self.sigmoid_loss
         )
@@ -107,16 +134,20 @@ class SupervisedGCN(base.Model):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        device_sampling: bool = False,
+        max_degree: Optional[int] = None,
     ):
         super().__init__()
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
+        self.init_device_sampling(device_sampling)
         self.label_idx = label_idx
         self.label_dim = label_dim
         self.metapath = [list(m) for m in metapath]
         self.max_nodes_per_hop = list(max_nodes_per_hop)
         self.max_edges_per_hop = list(max_edges_per_hop)
+        self.max_degree = max_degree
         self.feature_idx = feature_idx
         self.feature_dim = feature_dim
         self.max_id = max_id
@@ -124,6 +155,7 @@ class SupervisedGCN(base.Model):
         self.sparse_feature_idx = list(sparse_feature_idx)
         self.sparse_feature_max_ids = list(sparse_feature_max_ids)
         self.sparse_max_len = sparse_max_len
+        self._hop_adj_keys = [self.adj_key(m) for m in self.metapath]
         self.module = _SupervisedGCNModule(
             num_layers=len(self.metapath),
             dim=dim,
@@ -135,10 +167,24 @@ class SupervisedGCN(base.Model):
             max_id=max_id if use_id else -1,
             embedding_dim=embedding_dim,
             sparse_feature_max_ids=tuple(sparse_feature_max_ids),
+            hop_adj_keys=tuple(self._hop_adj_keys),
+            node_caps=tuple(self.max_nodes_per_hop),
         )
+
+    def build_consts(self, graph) -> dict:
+        consts = super().build_consts(graph)
+        if self.device_sampling:
+            self.add_sampling_consts(
+                consts, graph, self.metapath, max_degree=self.max_degree
+            )
+        return consts
 
     def sample(self, graph, inputs) -> dict:
         roots = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            # the full-neighbor multi-hop expansion happens inside the
+            # jitted step (deterministic — the seed is unused)
+            return self.device_sample_batch(roots)
         roots, hops = ops.get_multi_hop_neighbor(
             graph,
             roots,

@@ -228,6 +228,13 @@ class LasGNN(base.Model):
         self.group_sizes = list(group_sizes)
         self.max_id = max_id
         self.sparse_max_len = sparse_max_len
+        if device_sampling and max_id < 0:
+            # mirrors resolve_device_features: without it every id clips
+            # to 0 and the one-row consts tables train on garbage
+            raise ValueError(
+                "device_sampling=True requires max_id >= 0 (the "
+                "adjacency/feature tables are sized max_id+2)"
+            )
         self.init_device_sampling(device_sampling, require_features=False)
         # per group, per metapath: one consts["adj"] key per HOP (each hop
         # restricted to its own edge-type set — the host sample_fanout's

@@ -386,7 +386,8 @@ def build_model(args, graph):
             aggregator=args.aggregator,
             max_id=args.max_id,
             use_residual=args.use_residual,
-            device_features=args.device_features,
+            device_features=args.device_features or args.device_sampling,
+            device_sampling=args.device_sampling,
             **common_sup,
         )
     if name == "scalable_gcn":

@@ -511,6 +511,99 @@ def test_device_sampling_model_families(graph, family):
     assert np.isfinite(np.asarray(losses)).all()
 
 
+def test_multi_hop_neighbor_matches_host_exactly(graph, adj01):
+    """The device full-neighbor expansion is deterministic, so it must
+    reproduce the host ops.get_multi_hop_neighbor exactly: same sorted
+    unique node sets, same (src_id, dst_id) edge sets."""
+    from euler_tpu import ops
+
+    roots = np.array([10, 11, 16], dtype=np.int64)
+    caps = [8, 12]
+    h_roots, h_hops = ops.get_multi_hop_neighbor(
+        graph, roots, [[0, 1], [0, 1]],
+        max_nodes_per_hop=caps, max_edges_per_hop=[64, 256],
+        default_node=MAX_ID + 1,
+    )
+    d_hops = device.multi_hop_neighbor([adj01, adj01], roots, caps)
+
+    cur_ids = roots
+    for h, (hh, dh) in enumerate(zip(h_hops, d_hops)):
+        assert np.array_equal(
+            np.asarray(dh["nodes"]), hh.nodes.astype(np.int32)
+        ), f"hop {h} node sets differ"
+        # edge sets as (src node id, dst node id) pairs, real edges only
+        h_mask = hh.adj["mask"] > 0
+        h_edges = set(
+            zip(
+                cur_ids[hh.adj_src[h_mask]].tolist(),
+                hh.nodes[hh.adj_dst[h_mask]].tolist(),
+            )
+        )
+        d_mask = np.asarray(dh["mask"]) > 0
+        d_src = np.asarray(cur_ids)[np.asarray(dh["src"])[d_mask]]
+        d_dst = np.asarray(dh["nodes"])[np.asarray(dh["dst"])[d_mask]]
+        assert set(zip(d_src.tolist(), d_dst.tolist())) == h_edges, (
+            f"hop {h} edge sets differ"
+        )
+        # multi-edges (parallel edges across types) must keep multiplicity
+        assert d_mask.sum() == h_mask.sum(), f"hop {h} edge counts differ"
+        cur_ids = hh.nodes
+    # dedup overflow: cap smaller than the unique count drops the
+    # largest-id nodes instead of raising
+    tight = device.multi_hop_neighbor([adj01], roots, [2])
+    kept = np.asarray(tight[0]["nodes"])
+    full = np.unique(
+        np.asarray(h_hops[0].nodes[: h_hops[0].num_nodes])
+    )
+    assert np.array_equal(kept, np.sort(full)[:2].astype(np.int32))
+
+
+def test_supervised_gcn_device_matches_host_loss(graph):
+    """Same params, same roots: the device-expanded SupervisedGCN step
+    must produce the host path's loss (full-neighbor GCN has no sampling
+    randomness)."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu import models
+
+    kw = dict(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]], dim=8,
+        max_nodes_per_hop=[8, 12], max_edges_per_hop=[64, 256],
+        feature_idx=0, feature_dim=2, max_id=MAX_ID,
+    )
+    mh = models.SupervisedGCN(**kw)
+    md = models.SupervisedGCN(
+        **kw, device_features=True, device_sampling=True
+    )
+    roots = np.array([10, 11, 16], dtype=np.int64)
+
+    state_h = mh.init_state(
+        jax.random.PRNGKey(0), graph, roots,
+        __import__("optax").adam(0.01),
+    )
+    state_d = md.init_state(
+        jax.random.PRNGKey(0), graph, roots,
+        __import__("optax").adam(0.01),
+    )
+    # same module structure -> transplant host params into the device run
+    out_h = mh.module.apply(
+        {"params": state_h["params"]}, mh.sample(graph, roots)
+    )
+    out_d = md.module.apply(
+        {"params": state_h["params"]},
+        md.sample(graph, roots),
+        state_d["consts"],
+    )
+    np.testing.assert_allclose(
+        float(out_h.loss), float(out_d.loss), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_h.embedding), np.asarray(out_d.embedding),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
 def test_lasgnn_device_sampling_trains(graph):
     """LasGNN's structured batch (label + node-id groups) also runs the
     device path: host ships only labels/ids/seed, the per-group

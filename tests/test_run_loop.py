@@ -53,6 +53,18 @@ def test_shared_graph_mode(fixture_dir, tmp_path):
     assert os.listdir(reg) == []  # service stopped + deregistered
 
 
+def test_gcn_device_sampling_cli(fixture_dir, tmp_path):
+    """--device_sampling reaches the full-neighbor GCN: train + evaluate
+    run with the multi-hop expansion on device."""
+    ck = str(tmp_path / "ck_gcn_dev")
+    assert main(_args(fixture_dir, ck, "--model", "gcn",
+                      "--mode", "train", "--device_sampling", "true",
+                      "--num_epochs", "2")) == 0
+    assert main(_args(fixture_dir, ck, "--model", "gcn",
+                      "--mode", "evaluate", "--device_sampling",
+                      "true")) == 0
+
+
 @pytest.mark.parametrize(
     "name",
     ["line", "node2vec", "graphsage", "graphsage_supervised",
