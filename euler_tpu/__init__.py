@@ -7,12 +7,30 @@ model compute through an async prefetch pipeline, with data-parallel
 training over a jax.sharding.Mesh instead of parameter servers.
 """
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Make JAX_PLATFORMS effective even when a site hook pre-registered
+    # another backend at interpreter start (see
+    # parallel/mesh.py honor_jax_platforms_env): without this, examples
+    # and user scripts run with JAX_PLATFORMS=cpu still initialize the
+    # ambient TPU backend — which blocks forever when the chip is
+    # unreachable. Import-time, so it runs before any jax.devices().
+    # The env var is authoritative here (a site hook's config value is
+    # indistinguishable from a user's): code that wants a platform
+    # DIFFERENT from the launch env should call
+    # jax.config.update('jax_platforms', ...) after this import.
+    import jax as _jax
+
+    if _jax.config.jax_platforms != _os.environ["JAX_PLATFORMS"]:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from euler_tpu.graph.graph import Graph
 from euler_tpu.graph.convert import convert, convert_dicts
 from euler_tpu.graph.native import stats, stats_reset
 from euler_tpu.graph.service import GraphService
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Graph", "GraphService", "convert", "convert_dicts", "stats",

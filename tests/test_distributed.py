@@ -1,0 +1,133 @@
+"""Multi-process training over jax.distributed (the coordination plane).
+
+The reference's distributed training is TF parameter servers coordinated
+by ZooKeeper (reference tf_euler/python/run_loop.py:371-397); here the
+equivalent is N OS processes, each with its own host sampler and local
+devices, joined into ONE global mesh by jax.distributed — gradients
+all-reduce across process boundaries inside the jitted step. This test
+runs 2 real processes (2 virtual CPU devices each → a 4-device global
+data mesh) training SupervisedGraphSage on the shared fixture, and
+asserts the replicated states stay bit-identical across processes — the
+property the reference needs SyncExitHook + PS round-trips for.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, n_proc, port, fixture = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=n_proc, process_id=pid
+    )
+    import numpy as np
+    import euler_tpu
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import SupervisedGraphSage
+    from euler_tpu.parallel import (
+        batch_sharding, make_mesh, replicated_sharding,
+    )
+
+    # every process loads the full fixture (local graph mode — the
+    # sharded-service mode is covered by tests/test_remote.py)
+    graph = euler_tpu.Graph(directory=fixture)
+    model = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+    )
+    mesh = make_mesh()  # all 4 global devices, data axis
+    assert len(jax.devices()) == 2 * n_proc, jax.devices()
+    opt = train_lib.get_optimizer("adam", 0.05)
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8), opt
+    )
+    rep = replicated_sharding(mesh)
+    state = jax.device_put(state, rep)
+    step = jax.jit(
+        model.make_train_step(opt),
+        in_shardings=(rep, batch_sharding(mesh)),
+        out_shardings=(rep, rep, rep),
+        donate_argnums=(0,),
+    )
+    # global batch 16, each process samples ITS 8 roots (seeded per
+    # process so the halves differ, like independent host samplers)
+    rng = np.random.default_rng(100 + pid)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    bshard = NamedSharding(mesh, P("data"))
+    losses = []
+    for i in range(3):
+        local = model.sample(graph, rng.integers(0, 17, 8))
+        batch = jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(bshard, x),
+            local,
+        )
+        state, loss, metric = step(state, batch)
+        losses.append(float(loss))
+    # the replicated params must be identical across processes: hash a
+    # deterministic flatten of the local view
+    leaves = jax.tree.leaves(
+        jax.tree.map(
+            lambda x: np.asarray(
+                jax.device_get(x.addressable_data(0))
+            ).ravel(),
+            state["params"],
+        )
+    )
+    digest = float(sum(np.sum(np.abs(l)) for l in leaves))
+    print(f"RESULT pid={pid} losses={losses} digest={digest:.10f}",
+          flush=True)
+    """
+)
+
+
+def test_two_process_data_parallel_training(fixture_dir):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    # the workers set their own JAX env before importing jax
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), "2", str(port),
+             fixture_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    results = {}
+    for pid, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"pid {pid} failed:\n{err[-2000:]}"
+        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        results[pid] = line
+
+    # same losses and same param digest on both processes: the global
+    # all-reduce kept the replicated state in sync
+    r0 = results[0].split("pid=0 ")[1]
+    r1 = results[1].split("pid=1 ")[1]
+    assert r0 == r1, f"\n{results[0]}\n{results[1]}"
+    losses = eval(r0.split("losses=")[1].split(" digest=")[0])
+    assert all(np.isfinite(l) for l in losses)
