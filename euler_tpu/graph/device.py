@@ -48,6 +48,7 @@ def build_adjacency(
     max_id: int,
     max_degree: int | None = None,
     chunk: int = 65536,
+    sorted: bool = False,
 ) -> dict:
     """Export the adjacency restricted to ``edge_types`` as device slabs.
 
@@ -68,7 +69,7 @@ def build_adjacency(
     w_parts: list[np.ndarray] = []
     for lo in range(0, max_id + 1, chunk):
         ids = np.arange(lo, min(lo + chunk, max_id + 1), dtype=np.int64)
-        nbr, w, _, counts = graph.get_full_neighbor(ids, et)
+        nbr, w, _, counts = graph.get_full_neighbor(ids, et, sorted=sorted)
         counts_all[lo:lo + len(ids)] = counts
         nbr_parts.append(nbr)
         w_parts.append(w)
@@ -127,6 +128,8 @@ def build_adjacency(
         nb = nbr_flat[offsets[i]:offsets[i + 1]]
         wt = w_flat[offsets[i]:offsets[i + 1]]
         sel = np.argsort(wt)[::-1][:W]
+        if sorted:  # keep the heaviest W but preserve the id order
+            sel = np.sort(sel)
         nb, wt = nb[sel], wt[sel]
         total = wt.sum()
         if total <= 0:
@@ -144,6 +147,12 @@ def build_adjacency(
             "(renormalized)"
         )
     deg = np.minimum(counts_all, W).astype(np.int32)
+    # sorted=True rows are id-ordered (padding = default = largest id, so
+    # whole rows sort ascending) — the precondition for
+    # biased_random_walk's searchsorted membership test. Not recorded in
+    # the dict: consts pytrees are traced through jit, where a flag leaf
+    # could not be branch-checked anyway; callers keep sorted slabs under
+    # distinct consts keys (Model.adj_key(et, sorted=True)).
     return {
         "nbr": nbr_out,
         "cum": cum_out,
@@ -243,6 +252,77 @@ def random_walk(adj, roots, key, walk_len: int):
         cur = sample_neighbor(
             adjs[i], cur, jax.random.fold_in(key, i), 1
         )[:, 0]
+        cols.append(cur)
+    return jnp.stack(cols, axis=1)
+
+
+def biased_random_walk(adj, roots, key, walk_len: int, p: float, q: float):
+    """[len(roots), walk_len+1] int32 node2vec-biased walks on device
+    (reference euler/client/graph.cc:120-151 BuildWeights: candidate
+    weights scaled by 1/p when the candidate IS the parent [d_tx=0], 1
+    when the candidate is a neighbor of the parent [d_tx=1], 1/q
+    otherwise [d_tx=2], then a weighted draw over the rescaled row).
+
+    ``adj`` MUST be built with build_adjacency(..., sorted=True): the
+    d_tx=1 membership test is a per-row binary search of the current
+    node's candidates in the parent's id-sorted neighbor row. Step 0 has
+    no parent and takes the plain weighted draw, exactly like the host
+    walk. Dead ends chain into the default row and stay there.
+
+    With max_degree truncation the parent's slab row holds only its
+    heaviest W neighbors, so a dropped real neighbor classifies as
+    d_tx=2 (1/q) instead of d_tx=1 — a bias distortion on top of the
+    truncated sampling support. Size max_degree generously (or leave it
+    None) when p/q matter.
+    """
+    nbr, cum = adj["nbr"], adj["cum"]
+    deg, sampleable = adj["deg"], adj["sampleable"]
+    default = nbr.shape[0] - 1
+    W = nbr.shape[1]
+    cur = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
+    parent = jnp.full_like(cur, default)
+    prow = None  # parent's neighbor row = previous step's cand gather
+    cols = [cur]
+    slot = jnp.arange(W)
+    for step in range(walk_len):
+        cand = nbr[cur]                                    # [M, W]
+        c = cum[cur]
+        # per-slot weights from the normalized cumulative row; padding
+        # and unsampleable rows zero out
+        w = jnp.concatenate([c[:, :1], c[:, 1:] - c[:, :-1]], axis=1)
+        w = w * (slot[None, :] < deg[cur][:, None])
+        w = w * sampleable[cur][:, None]
+        if prow is not None:
+            # d_tx: parent-row membership via binary search (rows
+            # sorted); step 0 skips this — no parent, and a uniform 1/q
+            # would cancel in the normalization anyway
+            pos = jax.vmap(
+                lambda row, cds: jnp.searchsorted(row, cds)
+            )(prow, cand)
+            hit = jnp.take_along_axis(
+                prow, jnp.clip(pos, 0, W - 1), axis=1
+            ) == cand
+            in_parent_nbr = hit & (pos < deg[parent][:, None])
+            is_parent = cand == parent[:, None]
+            scale = jnp.where(
+                is_parent, 1.0 / p,
+                jnp.where(in_parent_nbr, 1.0, 1.0 / q),
+            )
+            w = w * scale
+        cw = jnp.cumsum(w, axis=1)
+        total = cw[:, -1:]
+        cw = cw / jnp.maximum(total, 1e-30)
+        u = jax.random.uniform(
+            jax.random.fold_in(key, step), (cur.shape[0], 1)
+        )
+        idx = jnp.clip((u >= cw).sum(-1), 0, W - 1)
+        nxt = jnp.take_along_axis(cand, idx[:, None], axis=1)[:, 0]
+        nxt = jnp.where(total[:, 0] > 0, nxt, default)
+        # next step's parent is this step's node; its neighbor row is
+        # exactly this step's cand gather — no second HBM gather.
+        # (Dead-ended walkers land on the default row whose weights are
+        # all zero, so their scale is irrelevant.)
+        parent, cur, prow = cur, nxt, cand
         cols.append(cur)
     return jnp.stack(cols, axis=1)
 

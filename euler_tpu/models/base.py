@@ -223,10 +223,14 @@ class Model:
         self._sample_seed = itertools.count(1)
 
     @staticmethod
-    def adj_key(edge_types) -> str:
+    def adj_key(edge_types, sorted: bool = False) -> str:
         """consts['adj'] key for one edge-type set (shared so every model
-        family and its module agree on the naming)."""
-        return "et" + "_".join(map(str, edge_types))
+        family and its module agree on the naming). sorted=True names the
+        id-sorted slab variant biased walks need."""
+        return (
+            "et" + "_".join(map(str, edge_types))
+            + ("_sorted" if sorted else "")
+        )
 
     def add_sampling_consts(
         self,
@@ -236,20 +240,24 @@ class Model:
         negs_type: Optional[int] = None,
         roots_type: Optional[int] = None,
         max_degree: Optional[int] = None,
+        sorted: bool = False,
     ) -> dict:
         """Upload the device-sampling structures: one adjacency slab per
         DISTINCT edge-type set plus optional typed node samplers for
         negatives and scan-loop roots (aliased when the types match).
         ``max_degree`` caps the slab width on heavy-tailed graphs
-        (heaviest neighbors kept, build_adjacency warns)."""
+        (heaviest neighbors kept, build_adjacency warns); ``sorted``
+        builds id-sorted rows (under their own keys) for
+        device_graph.biased_random_walk."""
         from euler_tpu.graph import device as device_graph
 
         adj = consts.setdefault("adj", {})
         for et in edge_type_sets:
-            k = self.adj_key(et)
+            k = self.adj_key(et, sorted=sorted)
             if k not in adj:
                 adj[k] = device_graph.build_adjacency(
-                    graph, et, self.max_id, max_degree=max_degree
+                    graph, et, self.max_id, max_degree=max_degree,
+                    sorted=sorted,
                 )
         if negs_type is not None:
             consts["negs"] = device_graph.build_node_sampler(

@@ -37,6 +37,11 @@ class _ShallowUnsupModule(nn.Module):
     right_win: int = 0
     has_features: bool = False
     has_sparse: bool = False
+    # node2vec bias; p=q=1 takes the plain-walk fast path. Biased walks
+    # need adj_key to name an id-SORTED slab (built by
+    # add_sampling_consts(sorted=True)).
+    walk_p: float = 1.0
+    walk_q: float = 1.0
 
     def setup(self):
         kw = dict(
@@ -77,9 +82,25 @@ class _ShallowUnsupModule(nn.Module):
         k_walk, k_neg = jax.random.split(key)
         adj = consts["adj"][self.adj_key]
         if self.walk_len > 0:
-            paths = device_graph.random_walk(
-                adj, roots, k_walk, self.walk_len
-            )
+            if self.walk_p != 1.0 or self.walk_q != 1.0:
+                # trace-time guard: biased membership search is garbage
+                # on unsorted rows; the naming convention (adj_key(et,
+                # sorted=True)) is the sortedness contract
+                if not self.adj_key.endswith("_sorted"):
+                    raise ValueError(
+                        "biased walks (walk_p/walk_q != 1) need an "
+                        "id-sorted adjacency slab: build consts with "
+                        "add_sampling_consts(sorted=True) and pass the "
+                        "matching adj_key(et, sorted=True)"
+                    )
+                paths = device_graph.biased_random_walk(
+                    adj, roots, k_walk, self.walk_len,
+                    self.walk_p, self.walk_q,
+                )
+            else:
+                paths = device_graph.random_walk(
+                    adj, roots, k_walk, self.walk_len
+                )
             ti, ci = ops.walk.pair_indices(
                 self.walk_len + 1, self.left_win, self.right_win
             )
@@ -160,12 +181,15 @@ class _ShallowUnsupervised(base.Model):
             )
         self.init_device_sampling(device_sampling, require_features=False)
 
+    adj_sorted = False  # Node2Vec sets True for biased (p/q != 1) walks
+
     def build_consts(self, graph) -> dict:
         consts = super().build_consts(graph)
         if self.device_sampling:
             self.add_sampling_consts(
                 consts, graph, [self.edge_type],
                 negs_type=self.node_type, roots_type=self.node_type,
+                sorted=self.adj_sorted,
             )
         return consts
 
@@ -251,19 +275,17 @@ class Node2Vec(_ShallowUnsupervised):
         **kwargs,
     ):
         super().__init__(node_type, max_id, **kwargs)
-        if self.device_sampling and (walk_p != 1.0 or walk_q != 1.0):
-            # the biased walk needs the sorted-merge d_tx reweighting
-            # (reference graph.cc:120-151) — host-only; p=q=1 degenerates
-            # to plain neighbor draws, the same fast path the reference
-            # takes (graph.cc:196-199)
-            raise ValueError(
-                "device_sampling supports p=q=1 walks only; use the host "
-                "path for biased node2vec"
-            )
         self.edge_type = list(edge_type)
         self.walk_len = walk_len
         self.walk_p = walk_p
         self.walk_q = walk_q
+        # biased walks reweight candidates by d_tx (reference
+        # graph.cc:120-151); on device that membership test runs over
+        # id-sorted slab rows. p=q=1 keeps the plain-draw fast path, the
+        # same degeneration the reference takes (graph.cc:196-199).
+        self.adj_sorted = self.device_sampling and (
+            walk_p != 1.0 or walk_q != 1.0
+        )
         self.left_win_size = left_win_size
         self.right_win_size = right_win_size
         self.batch_size_ratio = ops.walk.pair_count(
@@ -278,13 +300,15 @@ class Node2Vec(_ShallowUnsupervised):
             combiner=combiner,
             xent_loss=xent_loss,
             num_negs=self.num_negs,
-            adj_key=self.adj_key(self.edge_type),
+            adj_key=self.adj_key(self.edge_type, sorted=self.adj_sorted),
             walk_len=walk_len,
             left_win=left_win_size,
             right_win=right_win_size,
             has_features=self.device_features and self.feature_idx >= 0,
             has_sparse=self.device_features
             and bool(self.sparse_feature_idx),
+            walk_p=walk_p,
+            walk_q=walk_q,
         )
 
     def sample(self, graph, inputs) -> dict:

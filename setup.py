@@ -45,9 +45,20 @@ except ImportError:  # building an sdist without wheel installed
     pass
 
 
+def _version() -> str:
+    # single source: euler_tpu/__init__.py __version__ (regex-read — the
+    # package is not importable at build time without jax installed)
+    import re
+
+    with open(os.path.join(_ROOT, "euler_tpu", "__init__.py")) as f:
+        return re.search(
+            r'^__version__ = "([^"]+)"', f.read(), re.M
+        ).group(1)
+
+
 setuptools.setup(
     name="euler-tpu",
-    version="0.2.0",
+    version=_version(),
     description=(
         "TPU-native graph learning framework: C++ host graph engine + "
         "JAX/Flax/pjit training with device-resident sampling"
