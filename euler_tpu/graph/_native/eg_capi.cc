@@ -42,8 +42,15 @@ void eg_destroy(void* h) { delete API(h); }
 
 int eg_load(void* h, const char* dir, int shard_idx, int shard_num) {
   auto* e = Local(h);
-  if (!e->Load(dir, shard_idx, shard_num)) {
-    g_last_error = e->error();
+  try {
+    if (!e->Load(dir, shard_idx, shard_num)) {
+      g_last_error = e->error();
+      return -1;
+    }
+  } catch (const std::exception& ex) {
+    // corrupt input must surface as a Python error, never cross the C
+    // ABI as an exception (std::terminate -> SIGABRT)
+    g_last_error = std::string("graph load failed: ") + ex.what();
     return -1;
   }
   return 0;
@@ -51,9 +58,14 @@ int eg_load(void* h, const char* dir, int shard_idx, int shard_num) {
 
 int eg_load_files(void* h, const char** files, int nfiles) {
   auto* e = Local(h);
-  std::vector<std::string> fs(files, files + nfiles);
-  if (!e->LoadFiles(std::move(fs))) {
-    g_last_error = e->error();
+  try {
+    std::vector<std::string> fs(files, files + nfiles);
+    if (!e->LoadFiles(std::move(fs))) {
+      g_last_error = e->error();
+      return -1;
+    }
+  } catch (const std::exception& ex) {
+    g_last_error = std::string("graph load failed: ") + ex.what();
     return -1;
   }
   return 0;

@@ -82,7 +82,9 @@ class ByteCursor {
 
   template <typename T>
   bool ReadVec(size_t n, std::vector<T>* out) {
-    if (p_ + n * sizeof(T) > end_) return false;
+    // compare against remaining(): `p_ + n * sizeof(T)` overflows for
+    // corrupt huge n, slipping past the bound into resize()/memcpy
+    if (n > remaining() / sizeof(T)) return false;
     out->resize(n);
     if (n) std::memcpy(out->data(), p_, n * sizeof(T));
     p_ += n * sizeof(T);
@@ -90,14 +92,14 @@ class ByteCursor {
   }
 
   bool ReadStr(size_t n, std::string* out) {
-    if (p_ + n > end_) return false;
+    if (n > remaining()) return false;
     out->assign(p_, n);
     p_ += n;
     return true;
   }
 
   bool Skip(size_t n) {
-    if (p_ + n > end_) return false;
+    if (n > remaining()) return false;
     p_ += n;
     return true;
   }

@@ -77,14 +77,21 @@ bool Engine::LoadFiles(std::vector<std::string> files) {
   for (unsigned w = 0; w < nthreads; ++w) {
     threads.emplace_back([&, w]() {
       for (size_t i = w; i < files.size(); i += nthreads) {
-        std::string data;
-        if (!ReadWholeFile(files[i], &data)) {
-          io_errors[i] = "cannot read " + files[i];
-          continue;
+        try {
+          std::string data;
+          if (!ReadWholeFile(files[i], &data)) {
+            io_errors[i] = "cannot read " + files[i];
+            continue;
+          }
+          if (!parts[i].ParseFile(data.data(), data.size()) &&
+              parts[i].error.empty())
+            parts[i].error = "parse failure in " + files[i];
+        } catch (const std::exception& ex) {
+          // an exception escaping a worker thread is std::terminate —
+          // surface it like any other per-file error instead
+          io_errors[i] = std::string("load of ") + files[i] +
+                         " threw: " + ex.what();
         }
-        if (!parts[i].ParseFile(data.data(), data.size()) &&
-            parts[i].error.empty())
-          parts[i].error = "parse failure in " + files[i];
       }
     });
   }

@@ -58,6 +58,12 @@ bool Staging::ParseBlock(ByteCursor* cur) {
   float weight;
   if (!nc.Read(&id) || !nc.Read(&type) || !nc.Read(&weight) || !nc.Read(&T))
     return false;
+  // Corrupted types index the per-type sampler tables downstream
+  // (negative -> size_t wrap, huge -> unbounded resize) — reject here.
+  if (type < 0 || type > 1 << 20) {
+    error = "bad node type";
+    return false;
+  }
   if (T < 0 || T > 1 << 20) {
     error = "bad edge_type_num";
     return false;
@@ -110,7 +116,10 @@ bool Staging::ParseBlock(ByteCursor* cur) {
   std::vector<int32_t> sizes;
   if (!nc.ReadVec(static_cast<size_t>(nu), &sizes)) return false;
   size_t tot = 0;
-  for (int32_t s : sizes) tot += static_cast<size_t>(s);
+  for (int32_t s : sizes) {
+    if (s < 0) return false;  // negative count -> wild iterator in Build
+    tot += static_cast<size_t>(s);
+  }
   nf_u64_cnt.insert(nf_u64_cnt.end(), sizes.begin(), sizes.end());
   {
     std::vector<uint64_t> vals;
@@ -123,7 +132,10 @@ bool Staging::ParseBlock(ByteCursor* cur) {
   if (!FixCount(&nf_f32_num, nf, "node f32 feature num", &error)) return false;
   if (!nc.ReadVec(static_cast<size_t>(nf), &sizes)) return false;
   tot = 0;
-  for (int32_t s : sizes) tot += static_cast<size_t>(s);
+  for (int32_t s : sizes) {
+    if (s < 0) return false;
+    tot += static_cast<size_t>(s);
+  }
   nf_f32_cnt.insert(nf_f32_cnt.end(), sizes.begin(), sizes.end());
   {
     std::vector<float> vals;
@@ -173,6 +185,10 @@ bool Staging::ParseEdgeRecord(const char* data, size_t size) {
   float weight;
   if (!ec.Read(&src) || !ec.Read(&dst) || !ec.Read(&type) || !ec.Read(&weight))
     return false;
+  if (type < 0 || type > 1 << 20) {  // see node-type check above
+    error = "bad edge type";
+    return false;
+  }
   e_src.push_back(src);
   e_dst.push_back(dst);
   e_type.push_back(type);
@@ -184,7 +200,10 @@ bool Staging::ParseEdgeRecord(const char* data, size_t size) {
   if (!FixCount(&ef_u64_num, nu, "edge u64 feature num", &error)) return false;
   if (!ec.ReadVec(static_cast<size_t>(nu), &sizes)) return false;
   size_t tot = 0;
-  for (int32_t s : sizes) tot += static_cast<size_t>(s);
+  for (int32_t s : sizes) {
+    if (s < 0) return false;  // negative count -> wild iterator in Build
+    tot += static_cast<size_t>(s);
+  }
   ef_u64_cnt.insert(ef_u64_cnt.end(), sizes.begin(), sizes.end());
   {
     std::vector<uint64_t> vals;
@@ -197,7 +216,10 @@ bool Staging::ParseEdgeRecord(const char* data, size_t size) {
   if (!FixCount(&ef_f32_num, nf, "edge f32 feature num", &error)) return false;
   if (!ec.ReadVec(static_cast<size_t>(nf), &sizes)) return false;
   tot = 0;
-  for (int32_t s : sizes) tot += static_cast<size_t>(s);
+  for (int32_t s : sizes) {
+    if (s < 0) return false;
+    tot += static_cast<size_t>(s);
+  }
   ef_f32_cnt.insert(ef_f32_cnt.end(), sizes.begin(), sizes.end());
   {
     std::vector<float> vals;

@@ -105,11 +105,11 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "--device_sampling", type=_str2bool, default=False,
         help="also keep the ADJACENCY HBM-resident and sample fanouts/"
              "walks inside the jitted step (graphsage, "
-             "graphsage_supervised, scalable_sage, scalable_gcn, gat, "
-             "line, node2vec with p=q=1, lshne); the host ships only "
-             "root ids per step. For feature models this implies "
-             "--device_features; the shallow id-embedding models run it "
-             "standalone",
+             "graphsage_supervised, scalable_sage, gcn, scalable_gcn, "
+             "gat, line, node2vec incl. biased p/q walks, lshne); the "
+             "host ships only root ids per step. For feature models "
+             "this implies --device_features; the shallow id-embedding "
+             "models run it standalone",
     )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
