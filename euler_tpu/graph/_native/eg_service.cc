@@ -186,7 +186,17 @@ void Service::HandleConn(int fd) {
   while (!stopping_) {
     if (!RecvFrame(fd, &req)) break;
     reply.clear();
-    Dispatch(req, &reply);
+    try {
+      Dispatch(req, &reply);
+    } catch (const std::exception& ex) {
+      // an exception escaping this detached thread is std::terminate
+      // for the whole service — one malformed client must not take the
+      // shard down
+      WireWriter e;
+      e.U8(1);
+      e.Str(std::string("server error: ") + ex.what());
+      reply = std::move(e.buf());
+    }
     if (!SendFrame(fd, reply)) break;
   }
   // Deregister before close: Stop() only shuts down fds still in the set,
@@ -198,6 +208,27 @@ void Service::HandleConn(int fd) {
   ::close(fd);
   active_conns_.fetch_sub(1, std::memory_order_acq_rel);
 }
+
+namespace {
+
+// Result allocations derived from request integers must be bounded by
+// what a reply frame can carry anyway (SendFrame caps at kMaxFrame) —
+// otherwise a well-framed request with count=INT32_MAX forces a
+// multi-GB zero-initialized allocation (OOM kill or bad_alloc) before
+// any data is touched.
+bool OversizedResult(int64_t elems, std::string* reply) {
+  // -64: headroom for the status byte and array-length prefixes, so a
+  // boundary-sized result still fits its reply frame
+  if (elems >= 0 && elems <= static_cast<int64_t>((kMaxFrame - 64) / 8))
+    return false;
+  WireWriter e;
+  e.U8(1);
+  e.Str("oversized request");
+  *reply = std::move(e.buf());
+  return true;
+}
+
+}  // namespace
 
 void Service::Dispatch(const std::string& req, std::string* reply) const {
   eg::SpanTimer span(eg::kStatServiceRequest);
@@ -230,6 +261,7 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
     }
     case kSampleNode: {
       int32_t count = r.I32(), type = r.I32();
+      if (OversizedResult(count, reply)) return;
       std::vector<uint64_t> out(std::max<int32_t>(count, 0));
       if (r.ok() && count >= 0) engine_.SampleNode(count, type, out.data());
       w.Arr(out);
@@ -237,6 +269,7 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
     }
     case kSampleEdge: {
       int32_t count = r.I32(), type = r.I32();
+      if (OversizedResult(3LL * count, reply)) return;
       size_t n = static_cast<size_t>(std::max<int32_t>(count, 0));
       std::vector<uint64_t> src(n), dst(n);
       std::vector<int32_t> t(n);
@@ -261,6 +294,8 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
       const int32_t* etypes = r.Arr<int32_t>(&net);
       int32_t count = r.I32();
       uint64_t def = r.U64();
+      if (OversizedResult(3LL * n * std::max<int32_t>(count, 0), reply))
+        return;
       size_t total = static_cast<size_t>(n) * std::max<int32_t>(count, 0);
       std::vector<uint64_t> oid(total);
       std::vector<float> ow(total);
@@ -292,6 +327,8 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
       const int32_t* etypes = r.Arr<int32_t>(&net);
       int32_t k = r.I32();
       uint64_t def = r.U64();
+      if (OversizedResult(3LL * n * std::max<int32_t>(k, 0), reply))
+        return;
       size_t total = static_cast<size_t>(n) * std::max<int32_t>(k, 0);
       std::vector<uint64_t> oid(total);
       std::vector<float> ow(total);
@@ -312,6 +349,10 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
       const int32_t* dims = r.Arr<int32_t>(&nd);
       int64_t row = 0;
       for (int64_t k = 0; k < nd; ++k) row += dims[k];
+      // bound row before multiplying: corrupt dims could overflow n*row
+      // (OversizedResult also rejects a negative row)
+      if (OversizedResult(row, reply)) return;
+      if (OversizedResult(n * row, reply)) return;
       std::vector<float> out(static_cast<size_t>(n * row));
       if (r.ok() && nf == nd)
         engine_.GetDenseFeature(ids, static_cast<int>(n), fids, dims,
@@ -328,6 +369,8 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
       const int32_t* dims = r.Arr<int32_t>(&nd);
       int64_t row = 0;
       for (int64_t k = 0; k < nd; ++k) row += dims[k];
+      if (OversizedResult(row, reply)) return;
+      if (OversizedResult(n * row, reply)) return;
       std::vector<float> out(static_cast<size_t>(n * row));
       if (r.ok() && n == n2 && n == n3 && nf == nd)
         engine_.GetEdgeDenseFeature(src, dst, types, static_cast<int>(n),

@@ -19,7 +19,20 @@ def build_native(force: bool = False) -> str:
         for f in os.listdir(_NATIVE_DIR)
         if f.endswith((".cc", ".h"))
     ]
-    stale = force or not os.path.exists(_LIB_PATH) or any(
+    flavor = os.path.join(_NATIVE_DIR, ".flavor")
+    sanitized = False
+    if os.path.exists(flavor):
+        with open(flavor) as f:
+            sanitized = f.read().strip() != "normal"
+    if sanitized and any(
+        rt in os.environ.get("LD_PRELOAD", "")
+        for rt in ("libtsan", "libasan")
+    ):
+        # the sanitizer runtime is preloaded: this IS the sanitizer test
+        # run — keep the instrumented library (rebuilding normal here
+        # would make the run pass vacuously)
+        sanitized = False
+    stale = force or sanitized or not os.path.exists(_LIB_PATH) or any(
         os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in sources
     )
     if stale:

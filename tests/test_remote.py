@@ -242,3 +242,100 @@ def test_registry_lifecycle(tmp_path):
     assert len(files) == 1 and files[0].startswith("0#")
     svc.stop()
     assert os.listdir(reg) == []  # ephemeral-znode-style cleanup
+
+
+def test_service_survives_malformed_and_hostile_frames(fixture_dir):
+    """The shard service parses frames from the network; malformed or
+    adversarial requests must get an error reply (or a dropped
+    connection) — never kill the service or force a huge allocation.
+    Covers: random garbage, huge claimed lengths, truncated frames, and
+    well-framed requests whose count fields demand multi-GB results
+    (opcodes: 3=kSampleNode, 6=kSampleNeighbor, 9=kDenseFeature —
+    euler_tpu/graph/_native/eg_wire.h:27-35)."""
+    import os
+    import random
+    import socket
+    import struct
+
+    import euler_tpu
+    from euler_tpu.graph.service import GraphService
+
+    reg = fixture_dir + "_fuzz_reg"
+    os.makedirs(reg, exist_ok=True)
+    svc = GraphService(
+        data_dir=fixture_dir, shard_idx=0, shard_num=1, registry=reg
+    )
+    try:
+        port = int(svc.address.rsplit(":", 1)[1])
+
+        def send_raw(data, expect_reply=False):
+            s = socket.socket()
+            s.settimeout(3)
+            try:
+                s.connect(("127.0.0.1", port))
+                s.sendall(data)
+                if expect_reply:
+                    hdr = s.recv(4)
+                    assert len(hdr) == 4, "service dropped a valid frame"
+                    (ln,) = struct.unpack("<I", hdr)
+                    body = b""
+                    while len(body) < ln:
+                        chunk = s.recv(ln - len(body))
+                        assert chunk, "short reply"
+                        body += chunk
+                    return body
+                # no reply expected: just close — the server either
+                # errored the frame or is still waiting for bytes that
+                # will never come; both paths are exercised by the
+                # post-fuzz liveness check
+            finally:
+                s.close()
+
+        def frame(payload):
+            return struct.pack("<I", len(payload)) + payload
+
+        # hostile-but-well-framed: result sizes in the terabytes
+        int_max = 2**31 - 1
+        hostile = [
+            # kSampleNode count=INT_MAX
+            frame(struct.pack("<Bii", 3, int_max, -1)),
+            # kSampleNeighbor: 1 id, 1 etype, count=INT_MAX
+            frame(
+                struct.pack("<Bq", 6, 1) + struct.pack("<Q", 10)
+                + struct.pack("<q", 1) + struct.pack("<i", 0)
+                + struct.pack("<iQ", int_max, 0)
+            ),
+            # kDenseFeature: 1 id, 1 fid, dims=[INT_MAX]
+            frame(
+                struct.pack("<Bq", 9, 1) + struct.pack("<Q", 10)
+                + struct.pack("<q", 1) + struct.pack("<i", 0)
+                + struct.pack("<q", 1) + struct.pack("<i", int_max)
+            ),
+        ]
+        for payload in hostile:
+            body = send_raw(payload, expect_reply=True)
+            assert body[0] == 1, "hostile request must get error status"
+
+        # garbage fuzz: random frames, huge lengths, truncations
+        rng = random.Random(0)
+        for _ in range(200):
+            mode = rng.randrange(4)
+            if mode == 0:
+                send_raw(
+                    struct.pack("<I", rng.randrange(0, 1 << 31))
+                    + os.urandom(rng.randrange(0, 200))
+                )
+            elif mode == 1:
+                send_raw(struct.pack("<I", 0))
+            elif mode == 2:
+                send_raw(struct.pack("<I", 0x7FFFFFFF))
+            else:
+                send_raw(frame(os.urandom(rng.randrange(1, 100))))
+
+        # the service must still answer a real client correctly
+        g = euler_tpu.Graph(mode="remote", registry=reg)
+        ids = g.sample_node(16, -1)
+        assert set(int(i) for i in ids) <= set(range(10, 17))
+        g.close()
+    finally:
+        svc.stop()
