@@ -1,0 +1,169 @@
+"""Full distributed stack, no shared filesystem: the multi-host story.
+
+One test wires every distribution plane together the way a real 2-host
+pod would run (reference equivalent: ZooKeeper discovery + per-worker
+graph shards + TF parameter servers, run_loop.py:371-397 and
+scripts/dist_tf_euler.sh):
+
+  coordination plane  jax.distributed over a TCP coordinator
+  data plane          per-process C++ graph-service shard, discovered
+                      through the TCP registry (no shared directory)
+  training plane      one global 4-device mesh; per-process host
+                      samplers feed process-local batch shards; XLA
+                      all-reduces gradients across process boundaries
+
+Each process serves shard `pid` of the fixture, connects a REMOTE
+client (so every graph query exercises partition routing + cross-shard
+scatter/gather over TCP), trains SupervisedGraphSage for 3 steps, and
+reports a digest of its replicated params — which must be bit-identical
+across processes.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    pid, n_proc, coord_port, reg_url, fixture = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+        sys.argv[5],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        f"127.0.0.1:{coord_port}", num_processes=n_proc, process_id=pid
+    )
+    import numpy as np
+    import euler_tpu
+    from euler_tpu.graph.service import GraphService
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import SupervisedGraphSage
+    from euler_tpu.parallel import (
+        batch_sharding, make_mesh, replicated_sharding,
+    )
+
+    # data plane: serve THIS process's shard, register over TCP
+    svc = GraphService(
+        data_dir=fixture, shard_idx=pid, shard_num=n_proc,
+        registry=reg_url,
+    )
+    # wait until EVERY shard has registered before connecting (the same
+    # discovery wait run_loop does in shared mode, run_loop.py:268)
+    import time
+    from euler_tpu.graph import registry as registry_mod
+    deadline = time.time() + 60
+    while True:
+        shards = registry_mod.query(reg_url)
+        if len(shards) >= n_proc:
+            break
+        if time.time() > deadline:
+            raise TimeoutError(f"only {sorted(shards)} registered")
+        time.sleep(0.1)
+    # remote client: discovers both shards from the TCP registry
+    graph = euler_tpu.Graph(mode="remote", registry=reg_url)
+    assert graph.num_nodes == 7  # sees the WHOLE graph across shards
+
+    model = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+    )
+    mesh = make_mesh()
+    assert len(jax.devices()) == 2 * n_proc
+    opt = train_lib.get_optimizer("adam", 0.05)
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8), opt
+    )
+    rep = replicated_sharding(mesh)
+    state = jax.device_put(state, rep)
+    step = jax.jit(
+        model.make_train_step(opt),
+        in_shardings=(rep, batch_sharding(mesh)),
+        out_shardings=(rep, rep, rep),
+        donate_argnums=(0,),
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    bshard = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(100 + pid)
+    losses = []
+    for i in range(3):
+        # per-process sampling through the REMOTE client: global
+        # weighted sampling proportional to per-shard weight sums
+        roots = graph.sample_node(8, -1)
+        local = model.sample(graph, roots)
+        batch = jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(bshard, x),
+            local,
+        )
+        state, loss, metric = step(state, batch)
+        losses.append(float(loss))
+    leaves = jax.tree.leaves(
+        jax.tree.map(
+            lambda x: np.asarray(
+                jax.device_get(x.addressable_data(0))
+            ).ravel(),
+            state["params"],
+        )
+    )
+    digest = float(sum(np.sum(np.abs(l)) for l in leaves))
+    print(f"RESULT pid={pid} losses={losses} digest={digest:.10f}",
+          flush=True)
+    graph.close()
+    svc.stop()
+    """
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_full_stack_two_process_no_shared_fs(fixture_dir):
+    from euler_tpu.graph.registry import RegistryServer
+
+    reg = RegistryServer(host="127.0.0.1")
+    try:
+        coord_port = _free_port()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+        env.pop("XLA_FLAGS", None)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER, str(pid), "2",
+                 str(coord_port), reg.address, fixture_dir],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env,
+            )
+            for pid in range(2)
+        ]
+        results = {}
+        for pid, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            assert p.returncode == 0, f"pid {pid} failed:\n{err[-2500:]}"
+            results[pid] = [
+                l for l in out.splitlines() if l.startswith("RESULT")
+            ][0]
+
+        r0 = results[0].split("pid=0 ")[1]
+        r1 = results[1].split("pid=1 ")[1]
+        assert r0 == r1, f"\n{results[0]}\n{results[1]}"
+        losses = eval(r0.split("losses=")[1].split(" digest=")[0])
+        assert all(np.isfinite(l) for l in losses)
+    finally:
+        reg.stop()
