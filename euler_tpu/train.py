@@ -85,6 +85,7 @@ def train(
     profile_dir: Optional[str] = None,
     profile_steps: tuple = (10, 20),
     device_prefetch: bool = True,
+    sync_every: Optional[int] = None,
 ):
     """Train and return (state, history).
 
@@ -106,6 +107,21 @@ def train(
     """
     if mesh is None:
         mesh = make_mesh()
+    n_mesh_devices = int(np.prod(mesh.devices.shape))
+    cpu_virtual_mesh = (
+        n_mesh_devices > 1
+        and mesh.devices.reshape(-1)[0].platform == "cpu"
+    )
+    if sync_every is None:
+        # Async dispatch depth must be 1 on a multi-device CPU (virtual)
+        # mesh: XLA-CPU collectives BLOCK a shared pool thread inside the
+        # all-reduce rendezvous, so device programs queued from later
+        # steps can consume every pool thread while an earlier step's
+        # rendezvous still waits for its last participant — a livelock
+        # XLA resolves by aborting the process after 40 s. Real TPU
+        # queues per-device streams in hardware; a modest sync there just
+        # bounds queued-buffer memory.
+        sync_every = 1 if cpu_virtual_mesh else 32
     opt = get_optimizer(optimizer, learning_rate)
     if state is None:
         state = model.init_state(
@@ -141,9 +157,7 @@ def train(
         donate_argnums=(0,),
     )
 
-    if device_prefetch and len(mesh.devices.reshape(-1)) > 1 and (
-        mesh.devices.reshape(-1)[0].platform == "cpu"
-    ):
+    if device_prefetch and cpu_virtual_mesh:
         # XLA's CPU multi-device backend shares one in-process communicator:
         # device_put issued from prefetch worker threads can starve a
         # collective rendezvous inside a concurrently executing step (7 of 8
@@ -211,6 +225,8 @@ def train(
         state, last_loss, metric = step_fn(state, batch)
         window_metrics.append(metric)
         steps_done += 1
+        if sync_every and steps_done % sync_every == 0:
+            jax.block_until_ready(last_loss)
         if profiling and steps_done - start_step >= profile_steps[1]:
             jax.block_until_ready(last_loss)
             jax.profiler.stop_trace()

@@ -42,3 +42,48 @@ def meta():
 @pytest.fixture(scope="session")
 def nodes():
     return fixture_nodes()
+
+
+def run_worker_processes(worker_src: str, per_proc_args, timeout=300):
+    """Launch one python subprocess per args tuple running ``worker_src``
+    and return each one's stdout. Shared by the multi-process distributed
+    tests. Guarantees sibling cleanup: if any worker fails or times out,
+    the rest are killed (a surviving worker would otherwise sit blocked
+    in a jax.distributed collective holding its ports). Asserts rc==0
+    with the worker's stderr tail as the message."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker_src, *map(str, args)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env,
+        )
+        for args in per_proc_args
+    ]
+    outs = []
+    try:
+        for pid, p in enumerate(procs):
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"worker {pid} failed:\n{err[-2500:]}"
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

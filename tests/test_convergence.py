@@ -33,6 +33,7 @@ def planted(tmp_path_factory):
 
     d = tmp_path_factory.mktemp("planted")
     out_dir, info = build_planted(str(d))
+    info["out_dir"] = out_dir
     feat_acc = nearest_centroid_accuracy(info, use_neighbors=False)
     hop1_acc = nearest_centroid_accuracy(info, use_neighbors=True)
     # generator sanity: aggregation must be the thing that makes the task
@@ -216,4 +217,106 @@ def test_gcn_and_scalable_gcn_converge_within_tolerance(planted):
     assert f1_dev > f1_gcn - 0.05, (
         f"device-sampling ScalableGCN f1 {f1_dev:.3f} degrades more "
         f"than 0.05 below plain GCN {f1_gcn:.3f}"
+    )
+
+
+def _embedding_community_accuracy(emb, communities):
+    """Nearest-centroid community recovery in embedding space: centroids
+    fit from the TRUE communities on even nodes, accuracy on odd nodes.
+    Random = 1/NUM_CLASSES = 0.25."""
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9)
+    n = len(communities)
+    train = np.arange(n) % 2 == 0
+    centroids = np.stack(
+        [
+            emb[train & (communities == c)].mean(0)
+            for c in range(NUM_CLASSES)
+        ]
+    )
+    pred = (emb[~train] @ centroids.T).argmax(1)
+    return float((pred == communities[~train]).mean())
+
+
+_UNSUP_WORKER = """
+import sys
+import numpy as np
+import euler_tpu
+from euler_tpu import models
+from euler_tpu import train as train_lib
+
+family, out_dir, n_nodes = sys.argv[1], sys.argv[2], int(sys.argv[3])
+graph = euler_tpu.Graph(directory=out_dir)
+steps = 400 if family == "line2" else 300
+if family == "line2":
+    m = models.LINE(node_type=-1, edge_type=[0], max_id=n_nodes - 1,
+                    dim=32, order=2, num_negs=5)
+elif family.startswith("node2vec_biased"):
+    m = models.Node2Vec(
+        node_type=-1, edge_type=[0], max_id=n_nodes - 1, dim=32,
+        walk_len=3, walk_p=0.5, walk_q=2.0, num_negs=5,
+        device_sampling=family.endswith("_device"),
+    )
+else:
+    m = models.GraphSage(
+        node_type=-1, edge_type=[0], max_id=n_nodes - 1,
+        metapath=[[0], [0]], fanouts=[5, 5], dim=32, num_negs=5,
+        use_id=True, embedding_dim=32,
+    )
+state, hist = train_lib.train(
+    m, graph, lambda s: graph.sample_node(128, -1),
+    num_steps=steps, learning_rate=0.05, optimizer="adam", log_every=200,
+)
+emb = train_lib.save_embedding(m, graph, n_nodes - 1, state,
+                               batch_size=400)
+np.save(sys.argv[4], emb)
+print("MRR", hist[-1]["mrr"], flush=True)
+"""
+
+
+@pytest.mark.parametrize(
+    "family,acc_floor",
+    [
+        ("line2", 0.7),
+        ("node2vec_biased", 0.9),
+        ("node2vec_biased_device", 0.9),
+        ("unsup_sage", 0.55),
+    ],
+)
+def test_unsupervised_embeddings_recover_communities(planted, family,
+                                                     acc_floor, tmp_path):
+    """Unsupervised gates: loss/MRR trends can't catch an embedding that
+    descends without learning structure. On the planted-community graph
+    (intra_p=0.9) the community must be recoverable from the LEARNED
+    embeddings alone (no input features — id embeddings trained purely
+    from graph structure): nearest-centroid accuracy far above the 0.25
+    random baseline. Floors are calibrated ~0.1 under single-seed
+    observed values (LINE 0.89, biased Node2Vec 1.00, unsup GraphSage
+    0.73). The device variant runs the same biased walk (d_tx
+    reweighting) inside the jitted step.
+
+    Each family trains in its OWN subprocess: back-to-back trainings in
+    one process can starve an XLA-CPU collective rendezvous past its
+    hard 40 s abort on this oversubscribed 8-virtual-device host."""
+    import os
+    import subprocess
+    import sys
+
+    graph, info, _, _ = planted
+    comm = info["communities"]
+    out_npy = str(tmp_path / "emb.npy")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    r = subprocess.run(
+        [sys.executable, "-c", _UNSUP_WORKER, family, info["out_dir"],
+         str(NUM_NODES), out_npy],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    mrr = float(r.stdout.split("MRR")[1].strip())
+    assert mrr > 0.5, r.stdout
+    emb = np.load(out_npy)
+    acc = _embedding_community_accuracy(emb, comm)
+    assert acc > acc_floor, (
+        f"{family}: embedding community accuracy {acc:.3f} below "
+        f"{acc_floor} (random = 0.25)"
     )

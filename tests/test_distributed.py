@@ -11,9 +11,6 @@ asserts the replicated states stay bit-identical across processes — the
 property the reference needs SyncExitHook + PS round-trips for.
 """
 
-import os
-import subprocess
-import sys
 import textwrap
 
 import numpy as np
@@ -63,8 +60,7 @@ _WORKER = textwrap.dedent(
     # global batch 16, each process samples ITS 8 roots (seeded per
     # process so the halves differ, like independent host samplers)
     rng = np.random.default_rng(100 + pid)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    bshard = NamedSharding(mesh, P("data"))
+    bshard = batch_sharding(mesh)
     losses = []
     for i in range(3):
         local = model.sample(graph, rng.integers(0, 17, 8))
@@ -92,42 +88,22 @@ _WORKER = textwrap.dedent(
 
 
 def test_two_process_data_parallel_training(fixture_dir):
-    import socket
+    import ast
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    from tests.conftest import free_port, run_worker_processes
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
-    # the workers set their own JAX env before importing jax
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(pid), "2", str(port),
-             fixture_dir],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env,
-        )
-        for pid in range(2)
+    port = free_port()
+    outs = run_worker_processes(
+        _WORKER, [(pid, 2, port, fixture_dir) for pid in range(2)]
+    )
+    results = [
+        [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        for out in outs
     ]
-    results = {}
-    for pid, p in enumerate(procs):
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, f"pid {pid} failed:\n{err[-2000:]}"
-        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
-        results[pid] = line
-
     # same losses and same param digest on both processes: the global
     # all-reduce kept the replicated state in sync
     r0 = results[0].split("pid=0 ")[1]
     r1 = results[1].split("pid=1 ")[1]
     assert r0 == r1, f"\n{results[0]}\n{results[1]}"
-    losses = eval(r0.split("losses=")[1].split(" digest=")[0])
+    losses = ast.literal_eval(r0.split("losses=")[1].split(" digest=")[0])
     assert all(np.isfinite(l) for l in losses)

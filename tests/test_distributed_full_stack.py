@@ -19,10 +19,6 @@ reports a digest of its replicated params — which must be bit-identical
 across processes.
 """
 
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import numpy as np
@@ -89,8 +85,7 @@ _WORKER = textwrap.dedent(
         out_shardings=(rep, rep, rep),
         donate_argnums=(0,),
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    bshard = NamedSharding(mesh, P("data"))
+    bshard = batch_sharding(mesh)
     rng = np.random.default_rng(100 + pid)
     losses = []
     for i in range(3):
@@ -121,49 +116,30 @@ _WORKER = textwrap.dedent(
 )
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def test_full_stack_two_process_no_shared_fs(fixture_dir):
+    import ast
+
     from euler_tpu.graph.registry import RegistryServer
+    from tests.conftest import free_port, run_worker_processes
 
     reg = RegistryServer(host="127.0.0.1")
     try:
-        coord_port = _free_port()
-        env = dict(os.environ)
-        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
-        env.pop("XLA_FLAGS", None)
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-c", _WORKER, str(pid), "2",
-                 str(coord_port), reg.address, fixture_dir],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True, env=env,
-            )
-            for pid in range(2)
+        coord_port = free_port()
+        outs = run_worker_processes(
+            _WORKER,
+            [(pid, 2, coord_port, reg.address, fixture_dir)
+             for pid in range(2)],
+        )
+        results = [
+            [l for l in out.splitlines() if l.startswith("RESULT")][0]
+            for out in outs
         ]
-        results = {}
-        for pid, p in enumerate(procs):
-            try:
-                out, err = p.communicate(timeout=300)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                raise
-            assert p.returncode == 0, f"pid {pid} failed:\n{err[-2500:]}"
-            results[pid] = [
-                l for l in out.splitlines() if l.startswith("RESULT")
-            ][0]
-
         r0 = results[0].split("pid=0 ")[1]
         r1 = results[1].split("pid=1 ")[1]
         assert r0 == r1, f"\n{results[0]}\n{results[1]}"
-        losses = eval(r0.split("losses=")[1].split(" digest=")[0])
+        losses = ast.literal_eval(
+            r0.split("losses=")[1].split(" digest=")[0]
+        )
         assert all(np.isfinite(l) for l in losses)
     finally:
         reg.stop()
