@@ -138,3 +138,45 @@ def test_run_loop_shared_mode_tcp_registry(data_dir, tmp_path):
         "--batch_size", "8", "--log_steps", "2",
     ])
     assert rc == 0
+
+
+def test_registry_survives_hostile_connections():
+    """The TCP registry parses lines from the network; garbage frames,
+    huge claimed lengths, and oversized registration lines must never
+    kill it or poison its state (same bar as the shard-service fuzz in
+    tests/test_remote.py)."""
+    import os
+    import random
+    import socket
+    import struct
+
+    from euler_tpu.graph import registry as registry_mod
+    from euler_tpu.graph.registry import RegistryServer
+
+    reg = RegistryServer(host="127.0.0.1")
+    try:
+        port = int(reg.address.rsplit(":", 1)[1])
+        rng = random.Random(1)
+        for _ in range(150):
+            s = socket.socket()
+            s.settimeout(2)
+            try:
+                s.connect(("127.0.0.1", port))
+                mode = rng.randrange(4)
+                if mode == 0:
+                    s.sendall(os.urandom(rng.randrange(1, 200)))
+                elif mode == 1:
+                    s.sendall(
+                        struct.pack("<I", rng.randrange(0, 1 << 31))
+                        + os.urandom(50)
+                    )
+                elif mode == 2:
+                    s.sendall(b"REG " + os.urandom(500) + b"\n")
+                else:
+                    s.sendall(struct.pack("<I", 0x7FFFFFFF))
+            finally:
+                s.close()
+        # alive, and no hostile garbage registered as a shard
+        assert registry_mod.query(reg.address) == {}
+    finally:
+        reg.stop()
