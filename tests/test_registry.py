@@ -141,42 +141,44 @@ def test_run_loop_shared_mode_tcp_registry(data_dir, tmp_path):
 
 
 def test_registry_survives_hostile_connections():
-    """The TCP registry parses lines from the network; garbage frames,
-    huge claimed lengths, and oversized registration lines must never
+    """The TCP registry parses commands from the network; garbage at the
+    framing layer AND well-framed malformed command payloads must never
     kill it or poison its state (same bar as the shard-service fuzz in
     tests/test_remote.py)."""
     import os
     import random
-    import socket
-    import struct
 
-    from euler_tpu.graph import registry as registry_mod
-    from euler_tpu.graph.registry import RegistryServer
-
-    reg = RegistryServer(host="127.0.0.1")
-    try:
-        port = int(reg.address.rsplit(":", 1)[1])
+    with RegistryServer(host="127.0.0.1") as reg:
         rng = random.Random(1)
         for _ in range(150):
             s = socket.socket()
             s.settimeout(2)
             try:
-                s.connect(("127.0.0.1", port))
-                mode = rng.randrange(4)
-                if mode == 0:
+                s.connect(("127.0.0.1", reg.port))
+                mode = rng.randrange(5)
+                if mode == 0:  # raw garbage at the framing layer
                     s.sendall(os.urandom(rng.randrange(1, 200)))
-                elif mode == 1:
+                elif mode == 1:  # random claimed length + partial body
                     s.sendall(
                         struct.pack("<I", rng.randrange(0, 1 << 31))
                         + os.urandom(50)
                     )
-                elif mode == 2:
-                    s.sendall(b"REG " + os.urandom(500) + b"\n")
-                else:
+                elif mode == 2:  # well-framed random command payload
+                    p = os.urandom(rng.randrange(1, 120))
+                    s.sendall(struct.pack("<I", len(p)) + p)
+                elif mode == 3:  # well-framed malformed REG line: the
+                    # command parser itself must reject it
+                    p = b"REG " + os.urandom(60) + b"\n"
+                    s.sendall(struct.pack("<I", len(p)) + p)
+                else:  # huge claimed length, then hang up
                     s.sendall(struct.pack("<I", 0x7FFFFFFF))
+                if mode in (2, 3):  # framed commands get a reply (or a
+                    # clean drop); unframed modes never will — just close
+                    try:
+                        s.recv(64)
+                    except OSError:
+                        pass
             finally:
                 s.close()
         # alive, and no hostile garbage registered as a shard
-        assert registry_mod.query(reg.address) == {}
-    finally:
-        reg.stop()
+        assert query(reg.address) == {}
