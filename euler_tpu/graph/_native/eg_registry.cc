@@ -5,6 +5,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cctype>
 #include <cstdio>
 #include <cstring>
 #include <sstream>
@@ -74,6 +75,29 @@ void RegistryServer::HandleConn(int fd) {
   }
 }
 
+namespace {
+
+// A registration address must look like host:port — hostile bytes that
+// happen to parse as "<digits> <garbage>" must not become entries served
+// to every LIST client (state poisoning; the reference's ZK quotas play
+// this role for znode names).
+bool ValidAddr(const std::string& a) {
+  if (a.size() > 256) return false;
+  auto colon = a.rfind(':');
+  if (colon == std::string::npos || colon == 0 || colon + 1 >= a.size())
+    return false;
+  for (size_t i = colon + 1; i < a.size(); ++i)
+    if (!std::isdigit(static_cast<unsigned char>(a[i]))) return false;
+  for (size_t i = 0; i < colon; ++i) {
+    unsigned char c = static_cast<unsigned char>(a[i]);
+    if (!(std::isalnum(c) || c == '.' || c == '-' || c == '_'))
+      return false;
+  }
+  return true;
+}
+
+}  // namespace
+
 std::string RegistryServer::Dispatch(const std::string& req) {
   std::istringstream ss(req);
   std::string op;
@@ -83,7 +107,8 @@ std::string RegistryServer::Dispatch(const std::string& req) {
     int shard = -1;
     std::string addr;
     ss >> shard >> addr;
-    if (shard < 0 || addr.empty()) return "ERR bad request";
+    if (shard < 0 || shard > (1 << 20) || !ValidAddr(addr))
+      return "ERR bad request";
     std::lock_guard<std::mutex> l(mu_);
     if (op == "REG")
       entries_[{shard, addr}] = now + std::chrono::milliseconds(ttl_ms_);

@@ -93,9 +93,13 @@ def query(url: str, timeout_ms: int = 2000) -> dict[int, list[str]]:
     if n < 0:
         raise ConnectionError(f"registry unreachable: {url}")
     out: dict[int, list[str]] = {}
-    for line in buf.raw[:n].decode().splitlines():
+    # defensive decode: a (mis)behaving registry must not crash the
+    # client — skip any line that isn't "<int> host:port"
+    for line in buf.raw[:n].decode(errors="replace").splitlines():
         shard_s, _, addr = line.partition(" ")
-        if addr:
+        # isascii too: isdigit() alone accepts unicode digit-likes
+        # (superscripts) that int() then rejects
+        if addr and shard_s.isascii() and shard_s.isdigit():
             out.setdefault(int(shard_s), []).append(addr)
     return out
 
