@@ -217,7 +217,19 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     Exact CompactNode semantics: per draw, pick the first slot whose
     cumulative weight exceeds u. Nodes with no matching neighbors (and
     the default row) yield the default node.
+
+    When the adjacency carries a "packed" slab (added by
+    base.Model.add_sampling_consts on a single-device TPU backend), the
+    draw runs as one fused Pallas kernel instead of this op chain — same
+    distribution, ~3x faster at bench dims (graph/pallas_sampling.py).
     """
+    from euler_tpu.graph import pallas_sampling
+
+    if "packed" in adj and pallas_sampling.eligible(
+        int(np.prod(jnp.shape(nodes))), count
+    ):
+        seed = jax.random.randint(key, (), 0, jnp.iinfo(jnp.int32).max)
+        return pallas_sampling.sample_neighbor(adj, nodes, seed, count)
     nodes = jnp.asarray(nodes, dtype=jnp.int32)
     cum = adj["cum"][nodes]                       # [M, W]
     u = jax.random.uniform(key, (*nodes.shape, count))

@@ -251,6 +251,9 @@ class Model:
         device_graph.biased_random_walk."""
         from euler_tpu.graph import device as device_graph
 
+        from euler_tpu.graph import pallas_sampling
+
+        use_pallas = pallas_sampling.available()
         adj = consts.setdefault("adj", {})
         for et in edge_type_sets:
             k = self.adj_key(et, sorted=sorted)
@@ -259,6 +262,14 @@ class Model:
                     graph, et, self.max_id, max_degree=max_degree,
                     sorted=sorted,
                 )
+                if use_pallas and not sorted:
+                    # single-device TPU: add the packed slab that routes
+                    # sample_neighbor through the fused Pallas kernel
+                    # (sorted slabs feed biased walks, which read
+                    # nbr/cum directly — no packing needed)
+                    packed = pallas_sampling.pack_adjacency(adj[k])
+                    if packed is not None:
+                        adj[k]["packed"] = packed
         if negs_type is not None:
             consts["negs"] = device_graph.build_node_sampler(
                 graph, negs_type, self.max_id

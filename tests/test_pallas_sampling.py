@@ -1,0 +1,225 @@
+"""Fused Pallas sampling kernel vs the host engine and the XLA path.
+
+These tests require a real single-device TPU backend (the kernel uses
+TPU-only primitives — on-core PRNG, row DMA — that neither the CPU
+backend nor pallas interpret mode supports), so the whole module skips
+under the CPU conftest. Run manually on a chip (the env var keeps
+conftest.py from forcing the virtual CPU backend):
+
+    EULER_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_pallas_sampling.py -v
+
+The recorded on-chip run for this round is in PERF.md (step anatomy
+section); the distribution check mirrors tests/test_device_graph.py's
+statistical pinning of the XLA path against the host engine.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from euler_tpu.graph import pallas_sampling
+
+MAX_ID = 16  # fixture ids are 10..16 (tests/fixture_graph.py TOPOLOGY)
+
+tpu_only = pytest.mark.skipif(
+    not pallas_sampling.available(),
+    reason="needs a single-device TPU backend (pallas kernel path)",
+)
+
+
+# ---- activation guards (pure host logic, run everywhere) ----
+
+
+def test_eligible_budgets():
+    ps = pallas_sampling
+    assert ps.eligible(5120, 10)            # PPI hop-2 draw
+    assert ps.eligible(1, ps.MAX_COUNT)
+    assert not ps.eligible(1, ps.MAX_COUNT + 1)
+    assert not ps.eligible(204800, 10)      # [M, count] past the VMEM cap
+
+
+def test_pack_adjacency_hbm_budget():
+    small = {
+        "nbr": np.zeros((100, 8), np.int32),
+        "cum": np.ones((100, 8), np.float32),
+    }
+    assert pallas_sampling.pack_adjacency(small) is not None
+    # past the budget (this slab packs to exactly 100 KiB) — refused;
+    # at the default 2 GB cap that's the 10M-node-graph case
+    assert (
+        pallas_sampling.pack_adjacency(small, max_bytes=100 * 1024 - 1)
+        is None
+    )
+    wide = {
+        "nbr": np.zeros((4, 200), np.int32),
+        "cum": np.ones((4, 200), np.float32),
+    }
+    assert pallas_sampling.pack_adjacency(wide) is None
+
+
+def test_force_env_still_requires_tpu_backend(monkeypatch):
+    """EULER_TPU_PALLAS_SAMPLING=1 must not activate the kernel where its
+    TPU-only primitives cannot run (this suite's backend is CPU)."""
+    monkeypatch.setenv("EULER_TPU_PALLAS_SAMPLING", "1")
+    if jax.default_backend() != "tpu":
+        assert not pallas_sampling.available()
+    monkeypatch.setenv("EULER_TPU_PALLAS_SAMPLING", "0")
+    assert not pallas_sampling.available()
+
+
+# ---- kernel tests (single-device TPU only) ----
+
+
+@pytest.fixture(scope="module")
+def graph(tmp_path_factory):
+    import euler_tpu
+    from tests.fixture_graph import write_fixture
+
+    d = tmp_path_factory.mktemp("pallas_graph")
+    write_fixture(str(d))
+    return euler_tpu.Graph(directory=str(d))
+
+
+@pytest.fixture(scope="module")
+def adj(graph):
+    from euler_tpu.graph import device as dg
+
+    a = dg.build_adjacency(graph, [0, 1], MAX_ID)
+    packed = pallas_sampling.pack_adjacency(a)
+    assert packed is not None
+    a["packed"] = packed
+    return jax.device_put({k: jax.numpy.asarray(v) for k, v in a.items()})
+
+
+@tpu_only
+def test_packed_layout_roundtrip(adj):
+    packed = np.asarray(adj["packed"])
+    nbr = np.asarray(adj["nbr"])
+    cum = np.asarray(adj["cum"])
+    n, w = nbr.shape
+    assert packed.shape == (2 * n, pallas_sampling.LANES)
+    np.testing.assert_array_equal(packed[0::2, :w], nbr)
+    np.testing.assert_array_equal(
+        packed[1::2, :w].view(np.float32), cum
+    )
+    # pad lanes: unreachable (cum=1.0) and default-id filled
+    assert (packed[1::2, w:].view(np.float32) == 1.0).all()
+    assert (packed[0::2, w:] == n - 1).all()
+
+
+@tpu_only
+def test_shapes_and_default_fill(adj, graph):
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import device as dg
+
+    default = int(adj["nbr"].shape[0] - 1)
+    # the default row must draw itself; real nodes must draw in-graph
+    nodes = jnp.asarray([0, 1, default], jnp.int32)
+    out = jax.jit(
+        lambda n, k: dg.sample_neighbor(adj, n, k, 7)
+    )(nodes, jax.random.PRNGKey(0))
+    assert out.shape == (3, 7)
+    assert (np.asarray(out[2]) == default).all()
+    assert (np.asarray(out[:2]) <= default).all()
+
+
+@tpu_only
+def test_oob_ids_and_empty_input(adj):
+    """Out-of-range ids must clamp to the default row (the XLA path's
+    OOB-gather behavior) — in the kernel they are raw DMA offsets — and
+    an empty node set must return an empty array, not start unawaited
+    prologue DMAs."""
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import device as dg
+
+    default = int(adj["nbr"].shape[0] - 1)
+    nodes = jnp.asarray([default + 1, default + 1000, -3], jnp.int32)
+    out = jax.jit(
+        lambda n, k: dg.sample_neighbor(adj, n, k, 5)
+    )(nodes, jax.random.PRNGKey(1))
+    # rows past the slab clamp to the default row -> default node fill;
+    # negative ids clamp to row 0 -> in-graph draws
+    assert (np.asarray(out[:2]) == default).all()
+    assert (np.asarray(out[2]) <= default).all()
+
+    empty = jax.jit(
+        lambda n, k: dg.sample_neighbor(adj, n, k, 5)
+    )(jnp.zeros((0,), jnp.int32), jax.random.PRNGKey(2))
+    assert empty.shape == (0, 5)
+
+
+@tpu_only
+def test_distribution_matches_host_engine(adj, graph):
+    """Empirical draw frequencies ≈ the host engine's normalized edge
+    weights for every fixture node (the same gate the XLA path passes in
+    tests/test_device_graph.py)."""
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import device as dg
+
+    ids = np.arange(MAX_ID + 1)
+    nb, w, _, cnt = graph.get_full_neighbor(ids, [0, 1])
+    per_call, calls = 128, 32          # kernel caps count at MAX_COUNT;
+    draws = per_call * calls           # accumulate over folded keys
+    f = jax.jit(lambda n, k: dg.sample_neighbor(adj, n, k, per_call))
+    key = jax.random.PRNGKey(7)
+    out = np.concatenate(
+        [
+            np.asarray(f(jnp.asarray(ids, jnp.int32),
+                         jax.random.fold_in(key, c)))
+            for c in range(calls)
+        ],
+        axis=1,
+    )
+    off = 0
+    for i, c in enumerate(cnt):
+        c = int(c)
+        nbrs, ws = nb[off:off + c], w[off:off + c]
+        off += c
+        if c == 0 or ws.sum() <= 0:
+            assert (out[i] == MAX_ID + 1).all()
+            continue
+        expect = ws / ws.sum()
+        for n_, p in zip(nbrs, expect):
+            freq = (out[i] == n_).mean()
+            assert abs(freq - p) < 6 * np.sqrt(p * (1 - p) / draws) + 1e-3
+
+
+@tpu_only
+def test_fanout_routes_through_kernel_and_trains(adj, graph):
+    """sample_fanout picks up the packed slab, and a device-sampling
+    GraphSAGE step using it still descends."""
+    import jax.numpy as jnp
+    import optax
+
+    from euler_tpu.graph import device as dg
+    from euler_tpu.models import SupervisedGraphSage
+
+    roots = jnp.asarray(graph.sample_node(8, -1), jnp.int32)
+    hops = jax.jit(
+        lambda r, k: dg.sample_fanout([adj, adj], r, k, [3, 2])
+    )(roots, jax.random.PRNGKey(3))
+    assert [h.shape[0] for h in hops] == [8, 24, 48]
+
+    model = SupervisedGraphSage(
+        label_idx=0, label_dim=4, metapath=[[0, 1]] * 2, fanouts=[3, 2],
+        dim=16, feature_idx=0, feature_dim=2, max_id=MAX_ID,
+        device_features=True, device_sampling=True,
+    )
+    opt = optax.adam(0.05)
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, graph.sample_node(8, -1), opt
+    )
+    assert any(
+        "packed" in a for a in state["consts"]["adj"].values()
+    ), "available() TPU run must pack the slabs"
+    step = jax.jit(model.make_train_step(opt), donate_argnums=(0,))
+    losses = []
+    for i in range(30):
+        batch = model.device_sample_batch(graph.sample_node(8, -1))
+        state, loss, _ = step(state, batch)
+        losses.append(float(loss))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])
