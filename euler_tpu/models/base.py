@@ -14,6 +14,7 @@ sampler run ahead of the TPU.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -120,13 +121,16 @@ def upload_sparse_tables(
 def gather_consts(feats: dict, consts: dict) -> dict:
     """Materialize device-resident features for one node set: replace the
     host-side 'gids' indices with gathers from the HBM-resident tables
-    (dense rows, and padded sparse id+mask rows when configured)."""
+    (dense rows, and padded sparse id+mask rows when configured). A
+    reduced-precision table (feature_dtype='bfloat16') is cast back to
+    float32 after the gather so the module math is unchanged — only the
+    HBM-resident bytes (and the gather traffic) shrink."""
     if not consts or "gids" not in feats:
         return feats
     feats = dict(feats)
     g = feats["gids"]
     if "features" in consts:
-        feats["dense"] = consts["features"][g]
+        feats["dense"] = consts["features"][g].astype(jnp.float32)
     if "sparse" in consts and "sparse" not in feats:
         feats["sparse"] = [
             (t["ids"][g], t["mask"][g]) for t in consts["sparse"]
@@ -189,6 +193,12 @@ class Model:
     metric_name = "loss"
     batch_size_ratio = 1  # reference Model.batch_size_ratio
     device_features = False
+    # storage dtype for the device-resident dense feature table (model
+    # constructors expose this as the feature_dtype kwarg; the
+    # EULER_TPU_FEATURE_DTYPE env var overrides process-wide). None =
+    # float32. 'bfloat16' halves the table's HBM footprint and gather
+    # bytes; rows are cast back to float32 at the gather.
+    feature_dtype: Optional[str] = None
 
     def __init__(self):
         self.module: nn.Module = None
@@ -349,10 +359,29 @@ class Model:
         ids = np.arange(n, dtype=np.int64)
         consts = {}
         if getattr(self, "feature_idx", -1) >= 0:
+            # feature_dtype='bfloat16' (constructor kwarg or
+            # EULER_TPU_FEATURE_DTYPE env) halves the table's HBM
+            # footprint and the per-step gather bytes; rows are cast back
+            # to float32 at the gather (gather_consts), so everything
+            # downstream is unchanged. Labels stay float32 — they are
+            # loss targets, not gathered at fanout scale.
+            dt = self.feature_dtype or os.environ.get(
+                "EULER_TPU_FEATURE_DTYPE"
+            )
+            if dt:
+                try:
+                    dt = jnp.dtype(dt)
+                except TypeError as e:
+                    raise ValueError(
+                        f"bad feature table dtype {dt!r} (from the "
+                        "feature_dtype kwarg or EULER_TPU_FEATURE_DTYPE; "
+                        "use a numpy dtype name like 'bfloat16')"
+                    ) from e
             consts["features"] = jnp.asarray(
                 graph.get_dense_feature(
                     ids, [self.feature_idx], [self.feature_dim]
-                )
+                ),
+                dtype=dt or None,
             )
         if getattr(self, "label_idx", -1) >= 0:
             consts["labels"] = jnp.asarray(

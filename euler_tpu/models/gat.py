@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 import flax.linen as nn
+import jax.numpy as jnp
 import numpy as np
 
 from euler_tpu.models import base
@@ -52,7 +53,10 @@ class _GATModule(nn.Module):
         if "seq" in batch:
             return self.encoder(batch["seq"])
         # device-resident features: gather [B, nb+1, fdim] from the table
-        return self.encoder(consts["features"][seq_ids])
+        # (cast restores float32 when the table is stored reduced-precision)
+        return self.encoder(
+            consts["features"][seq_ids].astype(jnp.float32)
+        )
 
     def embed(self, batch, consts=None):
         seq_ids = None if "seq" in batch else self._seq_ids(batch, consts)
@@ -94,10 +98,12 @@ class GAT(base.Model):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
         train_node_type: int = -1,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )

@@ -134,10 +134,12 @@ class SupervisedGCN(base.Model):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
         max_degree: Optional[int] = None,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
@@ -304,10 +306,12 @@ class ScalableGCN(base.ScalableStoreModel):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
         train_node_type: int = -1,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )

@@ -119,10 +119,12 @@ class SupervisedGraphSage(base.Model):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
         train_node_type: int = -1,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.train_node_type = train_node_type
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id,
@@ -270,10 +272,12 @@ class ScalableSage(base.ScalableStoreModel):
         num_classes: Optional[int] = None,
         sigmoid_loss: bool = True,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
         train_node_type: int = -1,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )
@@ -496,9 +500,11 @@ class GraphSage(base.Model):
         use_id: bool = False,
         embedding_dim: int = 16,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.device_features = base.resolve_device_features(
             device_features, feature_idx, max_id
         )

@@ -10,7 +10,7 @@ tf_euler/kernels/random_walk_op.cc:31-140); the device sees fixed-shape
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import numpy as np
@@ -153,9 +153,11 @@ class _ShallowUnsupervised(base.Model):
         sparse_max_len: int = 16,
         num_negs: int = 5,
         device_features: bool = False,
+        feature_dtype: Optional[str] = None,
         device_sampling: bool = False,
     ):
         super().__init__()
+        self.feature_dtype = feature_dtype
         self.node_type = node_type
         self.max_id = max_id
         self.feature_idx = feature_idx

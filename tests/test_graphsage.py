@@ -171,3 +171,55 @@ def test_device_features_match_host_gather(graph):
     state2, loss, metric = step(state, batch)
     assert np.isfinite(float(loss))
     assert "consts" in state2
+
+
+def test_feature_dtype_bfloat16(graph, monkeypatch):
+    """feature_dtype='bfloat16' stores the feature table half-size in HBM;
+    rows are cast back to float32 at the gather (base.gather_consts), so
+    model math sees only the storage rounding. On the fixture (feature
+    values exactly representable in bfloat16) the result is identical to
+    the float32 path; labels must stay float32 regardless."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from euler_tpu.models import SupervisedGraphSage
+
+    kw = dict(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+        device_features=True,
+    )
+    roots = np.array([10, 12, 14, 16], dtype=np.int64)
+    opt = optax.adam(0.01)
+
+    m32 = SupervisedGraphSage(**kw)
+    s32 = m32.init_state(jax.random.PRNGKey(7), graph, roots, opt)
+
+    m16 = SupervisedGraphSage(**kw, feature_dtype="bfloat16")
+    s16 = m16.init_state(jax.random.PRNGKey(7), graph, roots, opt)
+    assert s16["consts"]["features"].dtype == jnp.bfloat16
+    assert s16["consts"]["labels"].dtype == jnp.float32
+
+    batch = m16.sample(graph, roots)
+    out32 = m32.module.apply(
+        {"params": s32["params"]}, batch, s32["consts"]
+    )
+    out16 = m16.module.apply(
+        {"params": s32["params"]}, batch, s16["consts"]
+    )
+    assert out16.embedding.dtype == jnp.float32  # cast back at the gather
+    np.testing.assert_allclose(
+        np.asarray(out16.loss), np.asarray(out32.loss), rtol=1e-6
+    )
+
+    # env-var spelling reaches build_consts too
+    monkeypatch.setenv("EULER_TPU_FEATURE_DTYPE", "bfloat16")
+    m_env = SupervisedGraphSage(**kw)
+    s_env = m_env.init_state(jax.random.PRNGKey(7), graph, roots, opt)
+    assert s_env["consts"]["features"].dtype == jnp.bfloat16
+    monkeypatch.delenv("EULER_TPU_FEATURE_DTYPE")
+
+    # a bogus dtype fails loudly, naming the knob
+    with pytest.raises(ValueError, match="feature_dtype"):
+        SupervisedGraphSage(**kw, feature_dtype="bf16").build_consts(graph)
