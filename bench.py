@@ -55,6 +55,27 @@ import numpy as np
 
 BASELINE_TARGET = 2_000_000.0  # edges/s/chip; see module docstring
 
+# A wedged chip/tunnel can "complete" dispatches without executing them
+# (observed 2026-07-30: 2.3 us/step reported right before the backend
+# went UNAVAILABLE mid-run). Gate every throughput number on physical
+# plausibility before it can become the headline: an empty-body scan
+# step alone measures 0.133 ms on this chip (PERF.md step anatomy), so
+# any train step under 30 us is not a measurement.
+MIN_CREDIBLE_STEP_MS = 0.03
+
+
+def _implausible(step_ms: float, loss) -> str | None:
+    """Non-None (reason) when a measured step time or loss cannot be a
+    real execution; callers must drop the number from the headline."""
+    if step_ms < MIN_CREDIBLE_STEP_MS:
+        return (
+            f"step {step_ms * 1e3:.1f}us < {MIN_CREDIBLE_STEP_MS * 1e3:.0f}us"
+            " floor: backend likely wedged (dispatches not executing)"
+        )
+    if loss is not None and not np.isfinite(float(np.asarray(loss).ravel()[-1])):
+        return "non-finite loss: execution produced garbage"
+    return None
+
 CONFIGS = {
     "ppi": dict(
         num_nodes=56944, avg_degree=15, feature_dim=50, label_dim=121,
@@ -284,11 +305,32 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
         ds["step_wall_ms"] = round(ds_dt / (chunks * chunk_steps) * 1e3, 4)
         ds["setup_s"] = round(upload_s, 2)
         ds["final_loss"] = round(float(np.asarray(last)[-1]), 4)
+        bogus = _implausible(ds["step_wall_ms"], last)
+        if bogus:
+            ds["implausible"] = bogus
         del state_ds
     except Exception as e:  # never lose the host-path number
         ds["error"] = f"{type(e).__name__}: {e}"[:300]
 
-    if ds.get("edges_per_sec", 0) > edges_per_sec:
+    host_bogus = _implausible(step_wall_ms, losses[-1])
+    if host_bogus:
+        # the host-path window is this metric's floor; if even it is
+        # fake, the whole config's numbers are untrustworthy
+        return {
+            "metric": (
+                f"{name}_edges/sec/chip" if name != "ppi" else "edges/sec/chip"
+            ),
+            "value": 0.0,
+            "unit": "edges/s",
+            "vs_baseline": 0.0,
+            "error": f"measurement rejected: {host_bogus}",
+            "detail": {"config": name, "platform": platform,
+                       "device_sampling": ds},
+        }
+    if (
+        ds.get("edges_per_sec", 0) > edges_per_sec
+        and "implausible" not in ds
+    ):
         edges_per_sec = ds["edges_per_sec"]
         sps = ds["steps_per_sec"]
     return {
