@@ -11,18 +11,19 @@ the on-core PRNG draws the uniforms, and the compare-sum pick happens on
 the rows while the next batch of rows is in flight. Same fanout measured
 at 0.24 ms/step — 3x over the XLA chain.
 
-Layout: ``pack_adjacency`` interleaves each node's neighbor-id row and
-cumulative-weight row (bitcast to int32) as adjacent rows of one
-``[2N, 128]`` array, so one 2-row DMA fetches both and the rows stay
-aligned to the (1, 128) HBM tiling that single-row slices require (a
-``[N, 256]`` array would tile (8, 128) and break scattered-row DMA).
-Slab width is padded to exactly 128 lanes: pad slots hold cum=1.0, which
-``idx = #(u >= cum)`` can never select while u < 1 (the last real slot
-is pinned to 1.0 at build time), and the VPU compares all 128 lanes in
-one op anyway, so the pad is free compute-wise. Graphs whose slab width
-exceeds 128 keep the XLA path (cap with ``build_adjacency(...,
-max_degree=128)`` to opt in — the same truncate-to-heaviest semantics
-the reference applies to heavy-tailed graphs).
+Layout: ``pack_adjacency`` stores each node as 2K adjacent rows of one
+``[2KN, 128]`` array — its K neighbor-id rows then its K
+cumulative-weight rows (bitcast to int32), K = ceil(W / 128) — so ONE
+2K-row DMA fetches the whole node and every row stays aligned to the
+(1, 128) HBM tiling that scattered-row slices require (a ``[N, 2K*128]``
+array would tile (8, 128) and break scattered-row DMA). Pad slots hold
+cum=1.0, which ``idx = #(u >= cum)`` can never select while u < 1 (the
+last real slot is pinned to 1.0 at build time), and the VPU compares
+each 128-lane row in one op anyway, so the pad is free compute-wise.
+Graphs whose slab width exceeds MAX_W = 512 keep the XLA path (cap with
+``build_adjacency(..., max_degree=512)`` to opt in — the same
+truncate-to-heaviest semantics the reference applies to heavy-tailed
+graphs).
 
 Draw semantics are identical to device.sample_neighbor — first slot
 whose cumulative weight exceeds u, default node for unsampleable rows
@@ -54,9 +55,12 @@ MAX_OUT_ELEMS = 1 << 20  # [M, count] output cap (4 MB VMEM): bigger
 MAX_M = 1 << 15  # source-node cap: ids ride scalar prefetch (SMEM, far
 # smaller than VMEM — 128 KB of ids at this cap), so M needs its own
 # bound even when M*count fits the output budget (e.g. count=1 walks)
+MAX_W = 4 * LANES  # widest slab the kernel handles (K = ceil(W/128)
+# row-pairs per node, compare-sum unrolled over K); wider keeps XLA
 MAX_PACKED_BYTES = 2 << 30  # pack_adjacency opt-out: the packed slab is
-# always 128 lanes wide (1 KB/node), a 128/W inflation over nbr+cum that
-# it is ADDED to; beyond this budget the kernel is not worth the HBM
+# always a K*128-lane multiple (1 KB/node per K), a (K*128)/W inflation
+# over nbr+cum that it is ADDED to; beyond this budget the kernel is not
+# worth the HBM
 _MAX_R = 512  # rows per pipeline stage (2 DMA semaphores regardless)
 
 
@@ -103,30 +107,36 @@ def eligible(m: int, count: int) -> bool:
 
 
 def pack_adjacency(adj: dict, max_bytes: int = MAX_PACKED_BYTES):
-    """[2N, 128] int32: row 2i = node i's neighbor ids (pad: default id),
-    row 2i+1 = its normalized cumulative weights bitcast to int32 (pad:
-    1.0). Returns None (caller keeps the XLA path) when the slab is wider
-    than one 128-lane register, or when the packed copy — which is KEPT
-    ALONGSIDE nbr/cum (the fallback paths still need them) at a fixed
-    1 KB/node regardless of real degree — would exceed ``max_bytes`` of
-    HBM."""
+    """[2KN, 128] int32, K = ceil(W/128): node i occupies rows
+    2K*i..2K*i+2K-1 — its K neighbor-id rows (pad: default id) then its
+    K cumulative-weight rows bitcast to int32 (pad: 1.0). Returns None
+    (caller keeps the XLA path) when the slab is wider than MAX_W, or
+    when the packed copy — which is KEPT ALONGSIDE nbr/cum (the fallback
+    paths still need them) at a fixed K KB/node regardless of real
+    degree — would exceed ``max_bytes`` of HBM."""
     nbr = np.asarray(adj["nbr"])
     cum = np.asarray(adj["cum"])
     n_rows, w = nbr.shape
-    if w > LANES or 2 * n_rows * LANES * 4 > max_bytes:
+    k = (w + LANES - 1) // LANES
+    if w > MAX_W or 2 * k * n_rows * LANES * 4 > max_bytes:
         return None
-    nbr_p = np.full((n_rows, LANES), n_rows - 1, np.int32)
+    nbr_p = np.full((n_rows, k * LANES), n_rows - 1, np.int32)
     nbr_p[:, :w] = nbr
-    cum_p = np.ones((n_rows, LANES), np.float32)
+    cum_p = np.ones((n_rows, k * LANES), np.float32)
     cum_p[:, :w] = cum
-    packed = np.empty((2 * n_rows, LANES), np.int32)
-    packed[0::2] = nbr_p
-    packed[1::2] = cum_p.view(np.int32)
+    packed = np.empty((2 * k * n_rows, LANES), np.int32)
+    # node-major: [nbr_0..nbr_{K-1}, cum_0..cum_{K-1}] per node
+    packed.reshape(n_rows, 2 * k, LANES)[:, :k] = nbr_p.reshape(
+        n_rows, k, LANES
+    )
+    packed.reshape(n_rows, 2 * k, LANES)[:, k:] = cum_p.view(
+        np.int32
+    ).reshape(n_rows, k, LANES)
     return packed
 
 
 def _kernel(ids_ref, seed_ref, ok_ref, pk_hbm, out_ref, pk_s, sem,
-            *, rows, count, num_iters, default):
+            *, rows, count, num_iters, default, k):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -135,11 +145,12 @@ def _kernel(ids_ref, seed_ref, ok_ref, pk_hbm, out_ref, pk_s, sem,
     pltpu.prng_seed(seed_ref[0])
 
     def dma(slot, r, row):
-        # one copy moves the node's (nbr, cum) row pair; every copy is
-        # the same size, so a single per-slot semaphore counts them all
+        # one copy moves the node's whole 2K-row block (K nbr rows + K
+        # cum rows); every copy is the same size, so a single per-slot
+        # semaphore counts them all
         return pltpu.make_async_copy(
-            pk_hbm.at[pl.ds(row * 2, 2), :],
-            pk_s.at[slot, pl.ds(2 * r, 2), :],
+            pk_hbm.at[pl.ds(row * 2 * k, 2 * k), :],
+            pk_s.at[slot, pl.ds(2 * k * r, 2 * k), :],
             sem.at[slot],
         )
 
@@ -163,9 +174,11 @@ def _kernel(ids_ref, seed_ref, ok_ref, pk_hbm, out_ref, pk_s, sem,
             issue(jax.lax.rem(it + 1, 2), it + 1)
 
         wait(slot, it)
-        both = pk_s[slot].reshape(rows, 2, LANES)
-        nbr = both[:, 0, :]                                # [rows, 128]
-        cum = pltpu.bitcast(both[:, 1, :], jnp.float32)
+        both = pk_s[slot].reshape(rows, 2 * k, LANES)
+        nbrs = [both[:, j, :] for j in range(k)]           # k x [rows, 128]
+        cums = [
+            pltpu.bitcast(both[:, k + j, :], jnp.float32) for j in range(k)
+        ]
         lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
         cols = []
         for _c in range(count):
@@ -176,13 +189,26 @@ def _kernel(ids_ref, seed_ref, ok_ref, pk_hbm, out_ref, pk_s, sem,
             u = (bits >> 8).astype(jnp.int32).astype(jnp.float32) * (
                 1.0 / (1 << 24)
             )
-            idx = jnp.sum((u >= cum).astype(jnp.int32), axis=1,
+            # rank over the whole (sorted) K*128-lane cumulative row
+            idx = jnp.sum((u >= cums[0]).astype(jnp.int32), axis=1,
                           keepdims=True)
-            idx = jnp.minimum(idx, LANES - 1)
-            cols.append(
-                jnp.sum(jnp.where(lanes == idx, nbr, 0), axis=1,
-                        keepdims=True)
+            for j in range(1, k):
+                idx = idx + jnp.sum(
+                    (u >= cums[j]).astype(jnp.int32), axis=1, keepdims=True
+                )
+            idx = jnp.minimum(idx, k * LANES - 1)
+            # select lane idx from the concatenated nbr rows: exactly one
+            # register's local lane matches (out-of-register locals match
+            # no lane and contribute 0)
+            val = jnp.sum(
+                jnp.where(lanes == idx, nbrs[0], 0), axis=1, keepdims=True
             )
+            for j in range(1, k):
+                val = val + jnp.sum(
+                    jnp.where(lanes == idx - j * LANES, nbrs[j], 0),
+                    axis=1, keepdims=True,
+                )
+            cols.append(val)
         row_out = jnp.concatenate(cols, axis=1)            # [rows, count]
         ok_blk = ok_ref[pl.ds(it * rows, rows), :]
         out_ref[pl.ds(it * rows, rows), :] = jnp.where(
@@ -206,7 +232,8 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     from jax.experimental.pallas import tpu as pltpu
 
     packed = adj["packed"]
-    n_rows = packed.shape[0] // 2
+    n_rows = adj["nbr"].shape[0]
+    k = packed.shape[0] // (2 * n_rows)  # ceil(W / 128) row-pairs/node
     nodes = jnp.asarray(nodes, jnp.int32)
     shape = nodes.shape
     flat = nodes.reshape(-1)
@@ -218,7 +245,10 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     # instead of reading past the slab (negatives clamp to row 0 rather
     # than wrapping pythonically; upstream batch prep already clips >= 0)
     flat = jnp.clip(flat, 0, n_rows - 1)
-    rows = _MAX_R if m >= _MAX_R else max(8, 1 << (m - 1).bit_length())
+    # power-of-two stage size (sublane-aligned dynamic slices), floored
+    # at 8, scaled down by K to keep the 2-slot scratch K-independent
+    max_r = max(8, 1 << ((_MAX_R // k).bit_length() - 1))
+    rows = max_r if m >= max_r else max(8, 1 << (m - 1).bit_length())
     mp = ((m + rows - 1) // rows) * rows
     ids = jnp.pad(flat, (0, mp - m))
     ok = adj["sampleable"][ids].astype(jnp.int32).reshape(-1, 1)
@@ -231,14 +261,14 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, 2 * rows, LANES), jnp.int32),
+            pltpu.VMEM((2, 2 * k * rows, LANES), jnp.int32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     out = pl.pallas_call(
         functools.partial(
             _kernel, rows=rows, count=count, num_iters=mp // rows,
-            default=n_rows - 1,
+            default=n_rows - 1, k=k,
         ),
         out_shape=jax.ShapeDtypeStruct((mp, count), jnp.int32),
         grid_spec=grid_spec,

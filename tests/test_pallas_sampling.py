@@ -51,11 +51,33 @@ def test_pack_adjacency_hbm_budget():
         pallas_sampling.pack_adjacency(small, max_bytes=100 * 1024 - 1)
         is None
     )
-    wide = {
-        "nbr": np.zeros((4, 200), np.int32),
-        "cum": np.ones((4, 200), np.float32),
+    # W=200 packs as K=2 now (test_packed_layout_wide_slab); only
+    # W > MAX_W refuses, covered there
+
+
+def test_packed_layout_wide_slab():
+    """W > 128 packs K = ceil(W/128) row-pairs per node (node-major: K
+    nbr rows then K cum rows); wider than MAX_W refuses."""
+    ps = pallas_sampling
+    rng = np.random.default_rng(0)
+    n, w = 10, 200                      # -> K = 2
+    nbr = rng.integers(0, n, (n, w)).astype(np.int32)
+    cum = np.sort(rng.random((n, w)).astype(np.float32), axis=1)
+    cum[:, -1] = 1.0
+    packed = ps.pack_adjacency({"nbr": nbr, "cum": cum})
+    assert packed is not None and packed.shape == (4 * n, ps.LANES)
+    blk = packed.reshape(n, 4, ps.LANES)
+    got_nbr = blk[:, :2].reshape(n, 2 * ps.LANES)
+    got_cum = blk[:, 2:].reshape(n, 2 * ps.LANES).view(np.float32)
+    np.testing.assert_array_equal(got_nbr[:, :w], nbr)
+    np.testing.assert_array_equal(got_cum[:, :w], cum)
+    assert (got_cum[:, w:] == 1.0).all()    # pad: unreachable while u < 1
+    assert (got_nbr[:, w:] == n - 1).all()  # pad: default id
+    too_wide = {
+        "nbr": np.zeros((4, ps.MAX_W + 1), np.int32),
+        "cum": np.ones((4, ps.MAX_W + 1), np.float32),
     }
-    assert pallas_sampling.pack_adjacency(wide) is None
+    assert ps.pack_adjacency(too_wide) is None
 
 
 def test_force_env_still_requires_tpu_backend(monkeypatch):
@@ -186,6 +208,47 @@ def test_distribution_matches_host_engine(adj, graph):
         for n_, p in zip(nbrs, expect):
             freq = (out[i] == n_).mean()
             assert abs(freq - p) < 6 * np.sqrt(p * (1 - p) / draws) + 1e-3
+
+
+@tpu_only
+def test_wide_slab_draws_cross_register_boundary():
+    """A W=200 (K=2) slab whose mass sits at slots 5 and 150 — one in
+    each 128-lane register — must draw exactly those neighbors at their
+    weights, proving the rank sum and the per-register select compose
+    across the boundary."""
+    import jax.numpy as jnp
+
+    ps = pallas_sampling
+    n, w = 8, 200
+    nbr = np.tile(np.arange(w, dtype=np.int32), (n, 1)) + 1000
+    cum = np.zeros((n, w), np.float32)
+    cum[:, 5:150] = 0.3
+    cum[:, 150:] = 1.0
+    adj = {
+        "nbr": jnp.asarray(nbr),
+        "cum": jnp.asarray(cum),
+        "sampleable": jnp.ones((n,), bool),
+        "packed": jnp.asarray(
+            ps.pack_adjacency({"nbr": nbr, "cum": cum})
+        ),
+    }
+    draws = 128
+    out = np.concatenate(
+        [
+            np.asarray(
+                ps.sample_neighbor(
+                    adj, jnp.arange(n, dtype=jnp.int32),
+                    jnp.int32(seed), draws,
+                )
+            )
+            for seed in range(16)
+        ],
+        axis=1,
+    )
+    vals, counts = np.unique(out, return_counts=True)
+    assert set(vals) == {1005, 1150}, vals
+    p150 = counts[vals == 1150][0] / out.size
+    assert abs(p150 - 0.7) < 6 * np.sqrt(0.7 * 0.3 / out.size) + 1e-3
 
 
 @tpu_only
