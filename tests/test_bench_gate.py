@@ -1,0 +1,75 @@
+"""bench.py robustness units: the plausibility gate and the killable
+backend probe. These are the driver-facing contracts (BENCH_r{N}.json is
+recorded unattended), so they get their own tests even though bench.py
+is a script, not part of the package.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+_BENCH_PY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"
+)
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", _BENCH_PY
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_implausible_rejects_wedged_timings(bench):
+    # the observed wedge: 2.3 us/step "measured" while the backend was
+    # completing dispatches without executing them
+    assert bench._implausible(0.0023, 0.5)
+    assert bench._implausible(0.0, 0.5)
+
+
+def test_implausible_rejects_garbage_losses(bench):
+    assert bench._implausible(1.0, float("nan"))
+    assert bench._implausible(1.0, np.asarray([0.1, np.inf]))
+
+
+def test_implausible_accepts_real_measurements(bench):
+    # the empty-body scan floor (0.133 ms) and real step times pass
+    assert bench._implausible(0.133, 0.5) is None
+    assert bench._implausible(1.27, np.asarray([0.7])) is None
+    assert bench._implausible(28.6, 0.69) is None  # CPU-fallback step
+
+
+def test_probe_backend_kills_hung_init(bench, monkeypatch):
+    """A backend init that hangs must be killed at the timeout and
+    reported, never block the bench process."""
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC", "import time; time.sleep(60)"
+    )
+    platform, err = bench.probe_backend(
+        attempts=2, timeout_s=0.5, backoff_s=0.0
+    )
+    assert platform is None
+    assert "timed out" in err and "attempt 2" in err
+
+
+def test_probe_backend_reports_failing_init(bench, monkeypatch):
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC", "import sys; sys.exit(3)"
+    )
+    platform, err = bench.probe_backend(
+        attempts=1, timeout_s=10.0, backoff_s=0.0
+    )
+    assert platform is None and "rc=3" in err
+
+
+def test_probe_backend_returns_platform(bench, monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC", "print('cpu')")
+    platform, err = bench.probe_backend(
+        attempts=1, timeout_s=30.0, backoff_s=0.0
+    )
+    assert platform == "cpu" and err is None
