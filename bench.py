@@ -281,6 +281,14 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
             jax.random.PRNGKey(0), graph,
             graph.sample_node(batch_size, -1), opt,
         )
+        # record whether the fused Pallas draw kernel is active (packed
+        # slabs present) — on single-chip TPU it should be
+        ds["pallas_kernel"] = bool(
+            any(
+                "packed" in a
+                for a in state_ds.get("consts", {}).get("adj", {}).values()
+            )
+        )
         state_ds = jax.device_put(state_ds, rep)
         chunk_steps = 50
         scan = jax.jit(

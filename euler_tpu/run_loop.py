@@ -111,6 +111,12 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
              "this implies --device_features; the shallow id-embedding "
              "models run it standalone",
     )
+    p.add_argument(
+        "--feature_dtype", default="",
+        help="storage dtype for the device-resident dense feature tables "
+             "(e.g. bfloat16: half the HBM footprint and gather bytes; "
+             "rows cast back to float32 at the gather). Empty = float32",
+    )
     p.add_argument("--use_residual", type=_str2bool, default=False)
     p.add_argument("--store_learning_rate", type=float, default=0.001)
     p.add_argument("--store_init_maxval", type=float, default=0.05)
@@ -387,6 +393,7 @@ def build_model(args, graph):
             max_id=args.max_id,
             use_residual=args.use_residual,
             device_features=args.device_features or args.device_sampling,
+            feature_dtype=args.feature_dtype or None,
             device_sampling=args.device_sampling,
             **common_sup,
         )
@@ -404,6 +411,7 @@ def build_model(args, graph):
             store_learning_rate=args.store_learning_rate,
             store_init_maxval=args.store_init_maxval,
             device_features=args.device_features or args.device_sampling,
+            feature_dtype=args.feature_dtype or None,
             device_sampling=args.device_sampling,
             train_node_type=args.train_node_type,
             **common_sup,
@@ -423,6 +431,7 @@ def build_model(args, graph):
             feature_idx=args.feature_idx,
             feature_dim=args.feature_dim,
             device_features=args.device_features or args.device_sampling,
+            feature_dtype=args.feature_dtype or None,
             device_sampling=args.device_sampling,
         )
     if name == "graphsage_supervised":
@@ -434,6 +443,7 @@ def build_model(args, graph):
             concat=args.concat,
             max_id=args.max_id,
             device_features=args.device_features or args.device_sampling,
+            feature_dtype=args.feature_dtype or None,
             device_sampling=args.device_sampling,
             train_node_type=args.train_node_type,
             **common_sup,
@@ -450,6 +460,7 @@ def build_model(args, graph):
             store_learning_rate=args.store_learning_rate,
             store_init_maxval=args.store_init_maxval,
             device_features=args.device_features or args.device_sampling,
+            feature_dtype=args.feature_dtype or None,
             device_sampling=args.device_sampling,
             train_node_type=args.train_node_type,
             **common_sup,
@@ -467,6 +478,7 @@ def build_model(args, graph):
             hidden_dim=args.dim,
             nb_num=5,
             device_features=args.device_features or args.device_sampling,
+            feature_dtype=args.feature_dtype or None,
             device_sampling=args.device_sampling,
             train_node_type=args.train_node_type,
         )

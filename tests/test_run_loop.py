@@ -65,6 +65,26 @@ def test_gcn_device_sampling_cli(fixture_dir, tmp_path):
                       "true")) == 0
 
 
+def test_feature_dtype_cli(fixture_dir, tmp_path, graph):
+    """--feature_dtype bfloat16 is threaded to the model as a real kwarg
+    (no process-global state) and the run trains end-to-end."""
+    args = define_flags().parse_args(
+        COMMON + ["--model", "graphsage_supervised",
+                  "--device_features", "true",
+                  "--feature_dtype", "bfloat16"]
+    )
+    model = build_model(args, graph)
+    assert model.feature_dtype == "bfloat16"
+    assert "EULER_TPU_FEATURE_DTYPE" not in os.environ
+
+    ck = str(tmp_path / "ck_bf16")
+    assert main(_args(fixture_dir, ck, "--model", "graphsage_supervised",
+                      "--mode", "train", "--device_features", "true",
+                      "--feature_dtype", "bfloat16",
+                      "--num_epochs", "2")) == 0
+    assert "EULER_TPU_FEATURE_DTYPE" not in os.environ
+
+
 @pytest.mark.parametrize(
     "name",
     ["line", "node2vec", "graphsage", "graphsage_supervised",
