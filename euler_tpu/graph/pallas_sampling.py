@@ -27,8 +27,10 @@ graphs).
 
 Draw semantics are identical to device.sample_neighbor — first slot
 whose cumulative weight exceeds u, default node for unsampleable rows
-(reference CompactNode::SampleNeighbor, euler/core/compact_node.cc:
-42-101) — but from the core PRNG's stream rather than threefry, so
+(baked into the slab: their neighbor lanes are default-filled at pack
+time, so the kernel needs no mask gather; reference
+CompactNode::SampleNeighbor, euler/core/compact_node.cc:42-101) — but
+from the core PRNG's stream rather than threefry, so
 sequences differ for the same seed while distributions match
 (statistically pinned against the host engine in
 tests/test_pallas_sampling.py, TPU-only).
@@ -122,6 +124,15 @@ def pack_adjacency(adj: dict, max_bytes: int = MAX_PACKED_BYTES):
         return None
     nbr_p = np.full((n_rows, k * LANES), n_rows - 1, np.int32)
     nbr_p[:, :w] = nbr
+    # unsampleable rows (zero total weight — their cum is a neutral
+    # all-1.0, see build_adjacency) draw the DEFAULT node on the host
+    # path via the `sampleable` mask; the packed slab is kernel-only, so
+    # bake that in by default-filling their neighbor lanes — the kernel
+    # then needs no separate mask gather at draw time
+    sampleable = np.asarray(
+        adj.get("sampleable", np.ones(n_rows, bool))
+    ).astype(bool)
+    nbr_p[~sampleable] = n_rows - 1
     cum_p = np.ones((n_rows, k * LANES), np.float32)
     cum_p[:, :w] = cum
     packed = np.empty((2 * k * n_rows, LANES), np.int32)
@@ -135,8 +146,8 @@ def pack_adjacency(adj: dict, max_bytes: int = MAX_PACKED_BYTES):
     return packed
 
 
-def _kernel(ids_ref, seed_ref, ok_ref, pk_hbm, out_ref, pk_s, sem,
-            *, rows, count, num_iters, default, k):
+def _kernel(ids_ref, seed_ref, pk_hbm, out_ref, pk_s, sem,
+            *, rows, count, num_iters, k):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -209,11 +220,9 @@ def _kernel(ids_ref, seed_ref, ok_ref, pk_hbm, out_ref, pk_s, sem,
                     axis=1, keepdims=True,
                 )
             cols.append(val)
-        row_out = jnp.concatenate(cols, axis=1)            # [rows, count]
-        ok_blk = ok_ref[pl.ds(it * rows, rows), :]
-        out_ref[pl.ds(it * rows, rows), :] = jnp.where(
-            ok_blk > 0, row_out, default
-        )
+        # unsampleable/default rows already hold the default id in every
+        # neighbor lane (pack_adjacency), so the draw needs no mask here
+        out_ref[pl.ds(it * rows, rows), :] = jnp.concatenate(cols, axis=1)
         return 0
 
     jax.lax.fori_loop(0, num_iters, body, 0)
@@ -251,12 +260,10 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     rows = max_r if m >= max_r else max(8, 1 << (m - 1).bit_length())
     mp = ((m + rows - 1) // rows) * rows
     ids = jnp.pad(flat, (0, mp - m))
-    ok = adj["sampleable"][ids].astype(jnp.int32).reshape(-1, 1)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # ids, seed
         grid=(1,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),   # ok
             pl.BlockSpec(memory_space=pl.ANY),       # packed slab (HBM)
         ],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -267,15 +274,13 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     )
     out = pl.pallas_call(
         functools.partial(
-            _kernel, rows=rows, count=count, num_iters=mp // rows,
-            default=n_rows - 1, k=k,
+            _kernel, rows=rows, count=count, num_iters=mp // rows, k=k,
         ),
         out_shape=jax.ShapeDtypeStruct((mp, count), jnp.int32),
         grid_spec=grid_spec,
     )(
         ids,
         jnp.atleast_1d(seed).astype(jnp.int32),
-        ok,
         packed,
     )
     return out[:m].reshape(*shape, count)

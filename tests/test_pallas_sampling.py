@@ -80,6 +80,25 @@ def test_packed_layout_wide_slab():
     assert ps.pack_adjacency(too_wide) is None
 
 
+def test_pack_bakes_unsampleable_rows_to_default():
+    """Zero-weight (unsampleable) rows default-fill their neighbor lanes
+    at pack time — the kernel's replacement for the host path's
+    `sampleable` mask — while sampleable rows keep their ids (pure host
+    numpy, runs everywhere)."""
+    ps = pallas_sampling
+    n, w = 6, 4
+    nbr = np.arange(n * w, dtype=np.int32).reshape(n, w)
+    cum = np.tile(np.linspace(0.25, 1.0, w, dtype=np.float32), (n, 1))
+    ok = np.array([True, False, True, True, False, True])
+    packed = ps.pack_adjacency({"nbr": nbr, "cum": cum, "sampleable": ok})
+    blk = packed.reshape(n, 2, ps.LANES)
+    for i in range(n):
+        if ok[i]:
+            np.testing.assert_array_equal(blk[i, 0, :w], nbr[i])
+        else:
+            assert (blk[i, 0] == n - 1).all()  # every lane -> default id
+
+
 def test_force_env_still_requires_tpu_backend(monkeypatch):
     """EULER_TPU_PALLAS_SAMPLING=1 must not activate the kernel where its
     TPU-only primitives cannot run (this suite's backend is CPU)."""
@@ -121,7 +140,11 @@ def test_packed_layout_roundtrip(adj):
     cum = np.asarray(adj["cum"])
     n, w = nbr.shape
     assert packed.shape == (2 * n, pallas_sampling.LANES)
-    np.testing.assert_array_equal(packed[0::2, :w], nbr)
+    # unsampleable rows bake the default-node fill into the slab
+    ok = np.asarray(adj["sampleable"]).astype(bool)
+    np.testing.assert_array_equal(
+        packed[0::2, :w], np.where(ok[:, None], nbr, n - 1)
+    )
     np.testing.assert_array_equal(
         packed[1::2, :w].view(np.float32), cum
     )
