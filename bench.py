@@ -317,6 +317,60 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
         if bogus:
             ds["implausible"] = bogus
         del state_ds
+
+        # Kernel A/B on the headline config: rerun the same scanned loop
+        # with the fused Pallas draw kernel forced off, so the recorded
+        # JSON carries the kernel's step-level contribution (TPU only;
+        # ppi only — Reddit's table setup is too slow to do twice).
+        if (
+            name == "ppi"
+            and platform == "tpu"
+            and ds.get("pallas_kernel")
+            and "implausible" not in ds
+        ):
+            prior = os.environ.get("EULER_TPU_PALLAS_SAMPLING")
+            os.environ["EULER_TPU_PALLAS_SAMPLING"] = "0"
+            try:
+                # the kernel on/off decision is made at init_state time
+                # (add_sampling_consts -> available()), so the SAME model
+                # object measures the same config on the other path
+                state_x = model_ds.init_state(
+                    jax.random.PRNGKey(0), graph,
+                    graph.sample_node(batch_size, -1), opt,
+                )
+                state_x = jax.device_put(state_x, rep)
+                scan_x = jax.jit(
+                    train_lib.make_scan_train(
+                        model_ds, opt, chunk_steps, batch_size
+                    ),
+                    donate_argnums=(0,),
+                )
+                state_x, lx = scan_x(state_x, 0)
+                jax.block_until_ready(lx)
+                ab_chunks = 4
+                t3 = time.perf_counter()
+                for c in range(1, ab_chunks + 1):
+                    state_x, lx = scan_x(state_x, c)
+                jax.block_until_ready(lx)
+                x_dt = time.perf_counter() - t3
+                x_wall_ms = x_dt / (ab_chunks * chunk_steps) * 1e3
+                x_bogus = _implausible(x_wall_ms, lx)
+                if x_bogus:
+                    ds["ab_error"] = f"measurement rejected: {x_bogus}"
+                else:
+                    x_sps = ab_chunks * chunk_steps / x_dt
+                    ds["xla_path_steps_per_sec"] = round(x_sps, 2)
+                    ds["kernel_step_speedup"] = round(
+                        ds["steps_per_sec"] / x_sps, 3
+                    )
+                del state_x
+            except Exception as e:
+                ds["ab_error"] = f"{type(e).__name__}: {e}"[:200]
+            finally:
+                if prior is None:
+                    os.environ.pop("EULER_TPU_PALLAS_SAMPLING", None)
+                else:
+                    os.environ["EULER_TPU_PALLAS_SAMPLING"] = prior
     except Exception as e:  # never lose the host-path number
         ds["error"] = f"{type(e).__name__}: {e}"[:300]
 
