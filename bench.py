@@ -49,6 +49,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -123,6 +124,21 @@ def probe_backend(attempts: int, timeout_s: float, backoff_s: float):
         tail = (r.stderr or r.stdout).strip().splitlines()
         errs.append(f"attempt {a + 1}: rc={r.returncode} {tail[-1] if tail else ''}")
     return None, "; ".join(errs)
+
+
+def _failure_line(name: str, error: str) -> dict:
+    """The driver-parseable headline shape for a run that produced no
+    measurement (shared by the per-config except path and the watchdog so
+    the schema cannot drift between them)."""
+    return {
+        "metric": (
+            "edges/sec/chip" if name == "ppi" else f"{name}_edges/sec/chip"
+        ),
+        "value": 0.0,
+        "unit": "edges/s",
+        "vs_baseline": 0.0,
+        "error": error,
+    }
 
 
 def _timed(fn, out_list):
@@ -468,6 +484,31 @@ def main() -> None:
 
         honor_jax_platforms_env()
 
+    # Watchdog (started AFTER the probe: probe children have their own
+    # subprocess timeouts, and a hard-exit mid-probe would orphan a child
+    # holding the chip): a relay that wedges after a successful probe
+    # leaves this process blocked in a C-level device wait that Python
+    # signal handlers cannot interrupt — a daemon thread can still print
+    # the driver-parseable failure line and hard-exit before the driver's
+    # own timeout would record nothing at all.
+    try:
+        deadline = float(os.environ.get("EULER_TPU_BENCH_DEADLINE", 2400))
+    except ValueError:
+        deadline = 2400.0
+    if deadline <= 0:
+        deadline = 2400.0
+
+    def _watchdog():
+        time.sleep(deadline)
+        print(json.dumps(_failure_line(
+            "ppi",
+            f"bench watchdog: exceeded {deadline:.0f}s "
+            "(backend hang mid-run?)",
+        )), flush=True)
+        os._exit(2)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     trace_dir = os.environ.get(
         "EULER_TPU_PROFILE_DIR", "/tmp/euler_tpu_bench_trace"
     )
@@ -481,14 +522,7 @@ def main() -> None:
             if tpu_error:
                 result["error"] = tpu_error
         except Exception as e:  # noqa: BLE001 — always emit the JSON line
-            result = {
-                "metric": "edges/sec/chip" if name == "ppi"
-                else f"{name}_edges/sec/chip",
-                "value": 0.0,
-                "unit": "edges/s",
-                "vs_baseline": 0.0,
-                "error": f"{type(e).__name__}: {e}",
-            }
+            result = _failure_line(name, f"{type(e).__name__}: {e}")
         if name == "ppi":
             headline = result
         else:

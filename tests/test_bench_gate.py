@@ -73,3 +73,24 @@ def test_probe_backend_returns_platform(bench, monkeypatch):
         attempts=1, timeout_s=30.0, backoff_s=0.0
     )
     assert platform == "cpu" and err is None
+
+
+def test_watchdog_emits_json_on_hang():
+    """A wedged backend after a successful probe blocks the process in a
+    C-level wait; the watchdog thread must still print the
+    driver-parseable failure line and hard-exit."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, EULER_TPU_BENCH_DEADLINE="2", JAX_PLATFORMS="")
+    r = subprocess.run(
+        [sys.executable, _BENCH_PY, "--probe-attempts", "1",
+         "--probe-timeout", "5", "--configs", "ppi"],
+        capture_output=True, text=True, timeout=90, env=env,
+        cwd=os.path.dirname(_BENCH_PY),
+    )
+    assert r.returncode == 2
+    j = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "watchdog" in j["error"] and j["value"] == 0.0
