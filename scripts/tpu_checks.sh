@@ -1,0 +1,13 @@
+#!/bin/sh
+# Everything that needs the real chip, in dependency order:
+#  1. the TPU-gated Pallas kernel suite (distribution pinning vs the host
+#     engine, OOB clamp, wide-slab register-boundary draw)
+#  2. the headline benchmark (device-sampling scan loop, kernel on/off
+#     A/B on the ppi config, prefetch-overlap breakdown, profiler trace)
+# CPU-only environments: the kernel suite skips itself; bench falls back
+# with an "error" field. Safe to run unattended (probe subprocesses are
+# killable; the bench has a hang watchdog).
+set -e
+cd "$(dirname "$0")/.."
+EULER_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_pallas_sampling.py -v
+python bench.py
