@@ -621,6 +621,32 @@ def test_node2vec_biased_device_sampling_trains(graph):
     assert np.isfinite(np.asarray(losses)).all()
 
 
+def _assert_hops_match_host(h_hops, d_hops, roots):
+    """Hop-by-hop equality of the device multi_hop_neighbor COO against
+    the host expansion: same sorted unique node sets, same (src node id,
+    dst node id) edge MULTIsets (multiplicity included). Shared with the
+    random-graph suite (tests/test_device_graph_random.py)."""
+    cur_ids = roots
+    for h, (hh, dh) in enumerate(zip(h_hops, d_hops)):
+        assert np.array_equal(
+            np.asarray(dh["nodes"]), hh.nodes.astype(np.int32)
+        ), f"hop {h} node sets differ"
+        h_mask = hh.adj["mask"] > 0
+        h_edges = sorted(
+            zip(
+                cur_ids[hh.adj_src[h_mask]].tolist(),
+                hh.nodes[hh.adj_dst[h_mask]].tolist(),
+            )
+        )
+        d_mask = np.asarray(dh["mask"]) > 0
+        d_src = np.asarray(cur_ids)[np.asarray(dh["src"])[d_mask]]
+        d_dst = np.asarray(dh["nodes"])[np.asarray(dh["dst"])[d_mask]]
+        assert sorted(zip(d_src.tolist(), d_dst.tolist())) == h_edges, (
+            f"hop {h} edge multisets differ"
+        )
+        cur_ids = hh.nodes
+
+
 def test_multi_hop_neighbor_matches_host_exactly(graph, adj01):
     """The device full-neighbor expansion is deterministic, so it must
     reproduce the host ops.get_multi_hop_neighbor exactly: same sorted
@@ -635,29 +661,7 @@ def test_multi_hop_neighbor_matches_host_exactly(graph, adj01):
         default_node=MAX_ID + 1,
     )
     d_hops = device.multi_hop_neighbor([adj01, adj01], roots, caps)
-
-    cur_ids = roots
-    for h, (hh, dh) in enumerate(zip(h_hops, d_hops)):
-        assert np.array_equal(
-            np.asarray(dh["nodes"]), hh.nodes.astype(np.int32)
-        ), f"hop {h} node sets differ"
-        # edge sets as (src node id, dst node id) pairs, real edges only
-        h_mask = hh.adj["mask"] > 0
-        h_edges = set(
-            zip(
-                cur_ids[hh.adj_src[h_mask]].tolist(),
-                hh.nodes[hh.adj_dst[h_mask]].tolist(),
-            )
-        )
-        d_mask = np.asarray(dh["mask"]) > 0
-        d_src = np.asarray(cur_ids)[np.asarray(dh["src"])[d_mask]]
-        d_dst = np.asarray(dh["nodes"])[np.asarray(dh["dst"])[d_mask]]
-        assert set(zip(d_src.tolist(), d_dst.tolist())) == h_edges, (
-            f"hop {h} edge sets differ"
-        )
-        # multi-edges (parallel edges across types) must keep multiplicity
-        assert d_mask.sum() == h_mask.sum(), f"hop {h} edge counts differ"
-        cur_ids = hh.nodes
+    _assert_hops_match_host(h_hops, d_hops, roots)
     # dedup overflow: cap smaller than the unique count drops the
     # largest-id nodes instead of raising
     tight = device.multi_hop_neighbor([adj01], roots, [2])

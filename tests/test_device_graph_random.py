@@ -186,6 +186,65 @@ def test_draw_distribution_matches_weights(graph, adj):
     assert checked_nonuniform  # exponential weights: not a uniform retest
 
 
+def test_multi_hop_matches_host_on_random_graph(graph, adj):
+    """The deterministic device full-neighbor expansion reproduces the
+    host ops.get_multi_hop_neighbor exactly at irregular scale — same
+    sorted unique node sets, same edge multisets — with dead ends and
+    the 150-degree hub in play."""
+    from euler_tpu import ops
+    from euler_tpu.graph import device
+    from tests.test_device_graph import _assert_hops_match_host
+
+    roots = np.array([HUB, 1, 2, 35, 170], dtype=np.int64)
+    # guard the tricky cases the roots claim to cover: a dead-end root
+    # and the multi-register hub
+    assert int(adj["deg"][170]) == 0 and 170 % DEAD_STRIDE == 0
+    assert int(adj["deg"][HUB]) == 150
+    caps = [256, 1024]
+    h_roots, h_hops = ops.get_multi_hop_neighbor(
+        graph, roots, [[0], [0]],
+        max_nodes_per_hop=caps, max_edges_per_hop=[4096, 65536],
+        default_node=N,
+    )
+    d_hops = device.multi_hop_neighbor([adj, adj], roots, caps)
+    _assert_hops_match_host(h_hops, d_hops, roots)
+
+
+def test_typed_negatives_distribution_at_scale(graph):
+    """sample_node_with_src draws each source's negatives from ITS node
+    type's weighted global sampler; at 300 nodes with non-uniform node
+    weights the per-type marginals must match the host-side weights."""
+    from euler_tpu.graph import device
+
+    ts = device.build_typed_node_sampler(graph, 2, N - 1)
+    src = np.asarray([4, 7], dtype=np.int64)  # one even-, one odd-type id
+    types = np.asarray(ts["types"])[src]
+    assert types[0] != types[1]
+    draws = 30000
+    out = np.asarray(
+        device.sample_node_with_src(
+            ts, jax.numpy.asarray(src, jax.numpy.int32),
+            jax.random.PRNGKey(2), draws,
+        )
+    )
+    ids_all = np.asarray(ts["ids"])
+    cum_all = np.asarray(ts["cum"])
+    off = np.asarray(ts["off"])
+    for r in range(len(src)):
+        t = int(types[r])
+        seg = slice(int(off[t]), int(off[t + 1]))
+        ids_t, cum_t = ids_all[seg], cum_all[seg]
+        probs = np.diff(cum_t, prepend=0.0)
+        # negatives stay within the source's type segment
+        assert set(out[r].tolist()) <= set(ids_t.tolist())
+        # spot-check the heaviest ten marginals
+        top = np.argsort(probs)[::-1][:10]
+        for j in top:
+            freq = (out[r] == ids_t[j]).mean()
+            bound = 6 * np.sqrt(probs[j] * (1 - probs[j]) / draws) + 1e-3
+            assert abs(freq - probs[j]) < bound, (r, ids_t[j])
+
+
 @pytest.mark.parametrize("pq", [(4.0, 0.25), (0.25, 4.0)])
 def test_biased_walk_analytic_on_random_graph(graph, pq):
     """The node2vec-biased device walk reproduces the analytic
