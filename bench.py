@@ -467,7 +467,10 @@ def main() -> None:
     names.sort(key=lambda n: n == "ppi")
 
     tpu_error = None
-    if os.environ.get("JAX_PLATFORMS", "") in ("", "axon", "tpu"):
+    # one gate for "JAX_PLATFORMS could resolve to the chip": the probe
+    # branch and the watchdog's CPU-deadline scaling must never disagree
+    tpu_possible = os.environ.get("JAX_PLATFORMS", "") in ("", "axon", "tpu")
+    if tpu_possible:
         platform, tpu_error = probe_backend(
             args.probe_attempts, args.probe_timeout, args.probe_backoff
         )
@@ -491,19 +494,37 @@ def main() -> None:
     # signal handlers cannot interrupt — a daemon thread can still print
     # the driver-parseable failure line and hard-exit before the driver's
     # own timeout would record nothing at all.
+    explicit_deadline = "EULER_TPU_BENCH_DEADLINE" in os.environ
     try:
         deadline = float(os.environ.get("EULER_TPU_BENCH_DEADLINE", 2400))
     except ValueError:
         deadline = 2400.0
+        explicit_deadline = False  # value discarded -> nothing honored
     if deadline <= 0:
         deadline = 2400.0
+        explicit_deadline = False
+    # CPU is legitimately ~an order of magnitude slower than the chip —
+    # whether via probe fallback (tpu_error) or an explicit
+    # JAX_PLATFORMS=cpu run; a healthy-but-slow CPU run must not be
+    # reported as a wedged backend, so the default deadline scales up
+    # (an explicit, parseable env deadline is honored as-is)
+    on_cpu = tpu_error is not None or not tpu_possible
+    if on_cpu and not explicit_deadline:
+        deadline *= 3.0
+
+    # the watchdog names whichever config was actually running when the
+    # deadline hit (not unconditionally the headline)
+    running = {"config": None}
 
     def _watchdog():
         time.sleep(deadline)
+        # headline ("ppi") metric shape so the driver's last-line parse
+        # always sees the contract, but the error names the config that
+        # was actually on the clock
         print(json.dumps(_failure_line(
             "ppi",
-            f"bench watchdog: exceeded {deadline:.0f}s "
-            "(backend hang mid-run?)",
+            f"bench watchdog: exceeded {deadline:.0f}s during config "
+            f"{running['config'] or '<pre-run>'} (backend hang mid-run?)",
         )), flush=True)
         os._exit(2)
 
@@ -514,6 +535,7 @@ def main() -> None:
     )
     headline = None
     for name in names:
+        running["config"] = name
         try:
             result = run_config(
                 name, CONFIGS[name],

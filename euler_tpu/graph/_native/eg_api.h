@@ -37,6 +37,14 @@ class GraphAPI {
                                  uint64_t* out) const = 0;
   virtual void GetNodeType(const uint64_t* ids, int n,
                            int32_t* out) const = 0;
+  // Per-node sampling weights (0 for unknown ids) — the device-graph
+  // exporter's feed (euler_tpu/graph/device.py build_node_sampler).
+  // Returns false when any row could not be resolved (remote shard
+  // unreachable): unlike the query ops, which degrade to defaults, a
+  // silently-zero weight would bias the exported sampler — callers must
+  // surface the failure.
+  virtual bool GetNodeWeight(const uint64_t* ids, int n,
+                             float* out) const = 0;
 
   // ---- neighbor ops ----
   virtual void SampleNeighbor(const uint64_t* ids, int n,

@@ -94,6 +94,12 @@ int eg_remote_shards(void* h) {
 int eg_remote_partitions(void* h) {
   return static_cast<RemoteGraph*>(API(h))->num_partitions();
 }
+// Current replica count of one shard's pool — observability for the
+// mid-run re-discovery path (and its tests).
+int eg_remote_replica_count(void* h, int shard) {
+  return static_cast<int>(
+      static_cast<RemoteGraph*>(API(h))->num_replicas(shard));
+}
 
 // ---- graph service (StartService equivalent,
 // reference euler/service/python_api.cc:26-52) ----
@@ -185,10 +191,14 @@ void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
   API(h)->SampleNodeWithSrc(src, n, count, out);
 }
 
-// Engine-only (local mode; the Python layer guards the mode): per-node
-// sampling weights for the device-graph exporter.
-void eg_get_node_weight(void* h, const uint64_t* ids, int n, float* out) {
-  Local(h)->GetNodeWeight(ids, n, out);
+// Per-node sampling weights for the device-graph exporter; works in both
+// modes (remote scatters a kNodeWeight RPC per shard). Returns 0 on
+// success, -1 when any shard could not answer (the exporter must not
+// build a sampler from silently-zero weights).
+int eg_get_node_weight(void* h, const uint64_t* ids, int n, float* out) {
+  if (API(h)->GetNodeWeight(ids, n, out)) return 0;
+  g_last_error = "node_weights: one or more shards unreachable";
+  return -1;
 }
 
 void eg_get_node_type(void* h, const uint64_t* ids, int n, int32_t* out) {

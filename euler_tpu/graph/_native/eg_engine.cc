@@ -146,12 +146,13 @@ void Engine::GetNodeType(const uint64_t* ids, int n, int32_t* out) const {
   }
 }
 
-void Engine::GetNodeWeight(const uint64_t* ids, int n, float* out) const {
+bool Engine::GetNodeWeight(const uint64_t* ids, int n, float* out) const {
 #pragma omp parallel for schedule(static) if (n > 1024)
   for (int i = 0; i < n; ++i) {
     int64_t idx = store_.NodeIndex(ids[i]);
     out[i] = idx >= 0 ? store_.NodeWeightAt(idx) : 0.0f;
   }
+  return true;
 }
 
 void Engine::SampleNeighbor(const uint64_t* ids, int n, const int32_t* etypes,

@@ -82,10 +82,12 @@ class Engine : public GraphAPI {
                          uint64_t* out) const;
 
   void GetNodeType(const uint64_t* ids, int n, int32_t* out) const;
-  // Engine-only (not in the remote protocol): per-node sampling weights,
-  // 0 for unknown ids. Used by the device-graph exporter to build the
-  // HBM-resident weighted root sampler.
-  void GetNodeWeight(const uint64_t* ids, int n, float* out) const;
+  // Per-node sampling weights, 0 for unknown ids. Used by the
+  // device-graph exporter to build the HBM-resident weighted root
+  // sampler; also served remotely via kNodeWeight so the exporter
+  // composes with sharded graphs. Always true locally (unknown ids are
+  // a resolved answer: weight 0).
+  bool GetNodeWeight(const uint64_t* ids, int n, float* out) const override;
 
   // ---- neighbor ops ----
   void SampleNeighbor(const uint64_t* ids, int n, const int32_t* etypes,

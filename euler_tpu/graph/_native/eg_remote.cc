@@ -67,32 +67,75 @@ bool ReadResult(WireReader* r, EGResult* out) {
 
 // ---------------- ConnPool ----------------
 
+ConnPool::Replica::~Replica() {
+  for (int fd : idle) ::close(fd);
+}
+
 void ConnPool::AddReplica(const std::string& host, int port) {
-  auto r = std::make_unique<Replica>();
+  auto r = std::make_shared<Replica>();
   r->host = host;
   r->port = port;
+  std::lock_guard<std::mutex> l(mu_);
   replicas_.push_back(std::move(r));
 }
 
-ConnPool::~ConnPool() {
-  for (auto& r : replicas_) {
-    std::lock_guard<std::mutex> l(r->mu);
-    for (int fd : r->idle) ::close(fd);
+void ConnPool::Update(const std::vector<std::pair<std::string, int>>& addrs) {
+  if (addrs.empty()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  std::vector<std::shared_ptr<Replica>> next;
+  next.reserve(addrs.size());
+  for (const auto& [host, port] : addrs) {
+    bool dup = false;
+    for (const auto& r : next)
+      if (r->host == host && r->port == port) dup = true;
+    if (dup) continue;
+    std::shared_ptr<Replica> keep;
+    for (const auto& r : replicas_)
+      if (r->host == host && r->port == port) keep = r;
+    if (!keep) {
+      keep = std::make_shared<Replica>();
+      keep->host = host;
+      keep->port = port;
+    }
+    next.push_back(std::move(keep));
   }
+  replicas_.swap(next);
+  // dropped replicas die (and close their pooled sockets) when the last
+  // in-flight Call snapshot releases them
+}
+
+std::vector<std::pair<std::string, int>> ConnPool::Addresses() const {
+  std::lock_guard<std::mutex> l(mu_);
+  std::vector<std::pair<std::string, int>> out;
+  out.reserve(replicas_.size());
+  for (const auto& r : replicas_) out.emplace_back(r->host, r->port);
+  return out;
+}
+
+size_t ConnPool::num_replicas() const {
+  std::lock_guard<std::mutex> l(mu_);
+  return replicas_.size();
 }
 
 bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
                     int timeout_ms, int quarantine_ms) const {
-  if (replicas_.empty()) return false;
+  // snapshot: Update() may swap the set mid-call; shared_ptrs keep every
+  // replica this exchange touches alive
+  std::vector<std::shared_ptr<Replica>> reps;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    reps = replicas_;
+  }
+  if (reps.empty()) return false;
   int64_t now = NowMs();
   for (int attempt = 0; attempt <= retries; ++attempt) {
     // Round-robin replica choice skipping quarantined hosts; if every host
     // is quarantined, use the nominal one anyway (matches the reference's
     // bad-host re-admission behavior, rpc_manager.cc:64).
-    size_t start = rr_.fetch_add(1) % replicas_.size();
-    Replica* rep = replicas_[start].get();
-    for (size_t k = 0; k < replicas_.size(); ++k) {
-      Replica* cand = replicas_[(start + k) % replicas_.size()].get();
+    size_t start = rr_.fetch_add(1) % reps.size();
+    Replica* rep = reps[start].get();
+    for (size_t k = 0; k < reps.size(); ++k) {
+      Replica* cand = reps[(start + k) % reps.size()].get();
       if (cand->bad_until_ms.load(std::memory_order_relaxed) <= now) {
         rep = cand;
         break;
@@ -124,6 +167,82 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
 
 // ---------------- RemoteGraph ----------------
 
+RemoteGraph::~RemoteGraph() {
+  if (rediscover_thread_.joinable()) {
+    rediscover_stop_.store(true, std::memory_order_release);
+    rediscover_thread_.join();
+  }
+}
+
+bool RemoteGraph::Discover(
+    std::map<int, std::vector<std::pair<std::string, int>>>* shards,
+    int timeout_ms) const {
+  shards->clear();
+  if (!reg_host_.empty()) {
+    // TCP registry discovery (eg_registry.h): LIST returns only live
+    // (unexpired) entries — the watch-children analog of the reference's
+    // ZK monitor (zk_server_monitor.cc:50-64).
+    std::map<int, std::vector<std::string>> listed;
+    if (!RegistryList(reg_host_, reg_port_, timeout_ms, &listed))
+      return false;
+    for (auto& [shard, addrs] : listed) {
+      for (auto& a : addrs) {
+        std::string host;
+        int port;
+        if (ParseHostPort(a, &host, &port))
+          (*shards)[shard].emplace_back(host, port);
+      }
+    }
+    return true;
+  }
+  if (!reg_dir_.empty()) {
+    DIR* d = opendir(reg_dir_.c_str());
+    if (!d) return false;
+    while (dirent* ent = readdir(d)) {
+      std::string name = ent->d_name;
+      size_t hash = name.find('#');
+      if (hash == std::string::npos || hash == 0) continue;
+      int shard = std::atoi(name.substr(0, hash).c_str());
+      std::ifstream f(reg_dir_ + "/" + name);
+      std::string line;
+      if (!std::getline(f, line)) continue;
+      std::string host;
+      int port;
+      if (ParseHostPort(line, &host, &port))
+        (*shards)[shard].emplace_back(host, port);
+    }
+    closedir(d);
+    return true;
+  }
+  return false;
+}
+
+void RemoteGraph::RediscoverLoop() {
+  // The polled form of the reference's ZK watch subscription
+  // (rpc_manager.h:77-80 + zk_server_monitor.cc:252-260): each pass
+  // re-LISTs the registry and diffs addresses into the pools, so a shard
+  // that died and came back on a new host:port serves again without the
+  // client being rebuilt. Shards absent from one listing keep their old
+  // replicas (quarantine handles them if truly gone) — TTL expiry is
+  // transient during a slow restart.
+  while (!rediscover_stop_.load(std::memory_order_acquire)) {
+    for (int slept = 0;
+         slept < rediscover_ms_ &&
+         !rediscover_stop_.load(std::memory_order_acquire);
+         slept += 50)
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    if (rediscover_stop_.load(std::memory_order_acquire)) break;
+    std::map<int, std::vector<std::pair<std::string, int>>> shards;
+    // short dial budget: a blackholed registry must not pin this thread
+    // (and thus ~RemoteGraph's join) for the full client timeout
+    if (!Discover(&shards, std::min(timeout_ms_, 1000))) continue;
+    for (int s = 0; s < num_shards_; ++s) {
+      auto it = shards.find(s);
+      if (it != shards.end()) pools_[s].Update(it->second);
+    }
+  }
+}
+
 bool RemoteGraph::Init(const std::string& config) {
   auto cfg = ParseConfig(config);
   if (cfg.count("retries")) retries_ = std::stoi(cfg["retries"]);
@@ -133,51 +252,23 @@ bool RemoteGraph::Init(const std::string& config) {
 
   // shard -> replica address list
   std::map<int, std::vector<std::pair<std::string, int>>> shards;
-  std::string reg_host;
-  int reg_port = 0;
   if (cfg.count("registry") &&
       cfg["registry"].compare(0, 6, "tcp://") == 0) {
-    // TCP registry discovery (eg_registry.h): LIST returns only live
-    // (unexpired) entries — the watch-children analog of the reference's
-    // ZK monitor (zk_server_monitor.cc:50-64).
-    if (!ParseTcpRegistry(cfg["registry"], &reg_host, &reg_port)) {
+    if (!ParseTcpRegistry(cfg["registry"], &reg_host_, &reg_port_)) {
       error_ = "bad tcp registry url " + cfg["registry"] +
                " (want tcp://host:port)";
       return false;
     }
-    std::map<int, std::vector<std::string>> listed;
-    if (!RegistryList(reg_host, reg_port, timeout_ms_, &listed)) {
+    if (!Discover(&shards, timeout_ms_)) {
       error_ = "cannot reach tcp registry " + cfg["registry"];
       return false;
     }
-    for (auto& [shard, addrs] : listed) {
-      for (auto& a : addrs) {
-        std::string host;
-        int port;
-        if (ParseHostPort(a, &host, &port))
-          shards[shard].emplace_back(host, port);
-      }
-    }
   } else if (cfg.count("registry")) {
-    DIR* d = opendir(cfg["registry"].c_str());
-    if (!d) {
+    reg_dir_ = cfg["registry"];
+    if (!Discover(&shards, timeout_ms_)) {
       error_ = "cannot open registry dir " + cfg["registry"];
       return false;
     }
-    while (dirent* ent = readdir(d)) {
-      std::string name = ent->d_name;
-      size_t hash = name.find('#');
-      if (hash == std::string::npos || hash == 0) continue;
-      int shard = std::atoi(name.substr(0, hash).c_str());
-      std::ifstream f(cfg["registry"] + "/" + name);
-      std::string line;
-      if (!std::getline(f, line)) continue;
-      std::string host;
-      int port;
-      if (ParseHostPort(line, &host, &port))
-        shards[shard].emplace_back(host, port);
-    }
-    closedir(d);
   } else if (cfg.count("shards")) {
     std::stringstream ss(cfg["shards"]);
     std::string shard_s;
@@ -292,6 +383,16 @@ bool RemoteGraph::Init(const std::string& config) {
   for (int t = 0; t < edge_type_num_; ++t) {
     for (int s = 0; s < num_shards_; ++s) w[s] = shard_edge_wsum_[s][t];
     edge_shard_by_type_[t].Build(w);
+  }
+
+  // Mid-run re-discovery (registry modes only; static shards= lists have
+  // no source to poll). Default 3000 ms; rediscover_ms=0 disables.
+  rediscover_ms_ = cfg.count("rediscover_ms")
+                       ? std::stoi(cfg["rediscover_ms"])
+                       : 3000;
+  if (rediscover_ms_ > 0 && (!reg_host_.empty() || !reg_dir_.empty())) {
+    rediscover_stop_ = false;
+    rediscover_thread_ = std::thread([this] { RediscoverLoop(); });
   }
   return true;
 }
@@ -424,6 +525,38 @@ void RemoteGraph::GetNodeType(const uint64_t* ids, int n,
     for (int64_t j = 0; j < m; ++j) out[rows[s][j]] = t[j];
     return true;
   });
+}
+
+bool RemoteGraph::GetNodeWeight(const uint64_t* ids, int n,
+                                float* out) const {
+  std::fill(out, out + n, 0.f);
+  std::vector<std::vector<int32_t>> rows;
+  GroupByShard(ids, n, &rows);
+  // Unlike the query ops (which degrade failed rows to defaults), a
+  // weight silently read as 0 would bias the exported device sampler —
+  // so per-shard success is tracked and surfaced.
+  std::vector<char> ok(num_shards_, 1);
+  ForShards(rows, [&](int s) {
+    ok[s] = 0;
+    std::vector<uint64_t> sub(rows[s].size());
+    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+    WireWriter req;
+    req.U8(kNodeWeight);
+    req.Arr(sub);
+    std::string reply;
+    if (!Call(s, req.buf(), &reply)) return false;
+    WireReader r(reply);
+    r.U8();
+    int64_t m;
+    const float* w = r.Arr<float>(&m);
+    if (!r.ok() || m != static_cast<int64_t>(sub.size())) return false;
+    for (int64_t j = 0; j < m; ++j) out[rows[s][j]] = w[j];
+    ok[s] = 1;
+    return true;
+  });
+  for (int s = 0; s < num_shards_; ++s)
+    if (!rows[s].empty() && !ok[s]) return false;
+  return true;
 }
 
 void RemoteGraph::SampleNodeWithSrc(const uint64_t* src, int n, int count,

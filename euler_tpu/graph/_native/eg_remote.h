@@ -19,9 +19,12 @@
 
 #include <atomic>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
+#include <utility>
 #include <vector>
 
 #include "eg_api.h"
@@ -33,9 +36,16 @@ namespace eg {
 
 // Connection pool over the replicas of one shard: round-robin with
 // quarantine of failing hosts, idle-socket reuse, retry across replicas.
+// The replica set is mutable at runtime (mid-run re-discovery, the role
+// of the reference's ZK watch callbacks adding/removing channels while
+// training runs, rpc_manager.h:77-80): Call snapshots the shared_ptr
+// vector under a brief lock, so Update never invalidates an in-flight
+// exchange — a dropped replica's sockets close when its last reference
+// (pool or call) goes away.
 class ConnPool {
  public:
   struct Replica {
+    ~Replica();  // closes pooled sockets
     std::string host;
     int port = 0;
     std::atomic<int64_t> bad_until_ms{0};
@@ -44,9 +54,15 @@ class ConnPool {
   };
 
   void AddReplica(const std::string& host, int port);
-  ~ConnPool();
+  // Replace the replica set: existing (host, port) entries keep their
+  // Replica object (pooled sockets + quarantine state survive), new
+  // addresses are added, missing ones dropped. An empty `addrs` is a
+  // no-op — a transiently empty/unreachable listing must never strand
+  // the pool with zero replicas.
+  void Update(const std::vector<std::pair<std::string, int>>& addrs);
+  std::vector<std::pair<std::string, int>> Addresses() const;
 
-  size_t num_replicas() const { return replicas_.size(); }
+  size_t num_replicas() const;
 
   // One request/reply exchange; retries across replicas. Returns false when
   // every attempt failed (reply undefined).
@@ -54,7 +70,8 @@ class ConnPool {
             int timeout_ms, int quarantine_ms) const;
 
  private:
-  std::vector<std::unique_ptr<Replica>> replicas_;
+  mutable std::mutex mu_;  // guards replicas_ (the vector, not the pools)
+  std::vector<std::shared_ptr<Replica>> replicas_;
   mutable std::atomic<size_t> rr_{0};
 };
 
@@ -65,12 +82,22 @@ class RemoteGraph : public GraphAPI {
   //   registry=<dir>        flat-file registry written by Service::Start, OR
   //   shards=<h:p|h:p,...>  explicit per-shard replica lists
   //                         (',' separates shards, '|' separates replicas)
-  //   retries (default 3), timeout_ms (5000), quarantine_ms (3000)
+  //   retries (default 3), timeout_ms (5000), quarantine_ms (3000),
+  //   rediscover_ms (default 3000 with registry=, 0 = off): period of the
+  //   background registry re-LIST that diffs shard addresses into the
+  //   ConnPools — the reference's ZK watch-children semantics
+  //   (zk_server_monitor.cc:252-260 OnAddChild/OnRemoveChild) by polling,
+  //   so a shard restarted on a NEW address is re-learned mid-run.
   bool Init(const std::string& config);
+  ~RemoteGraph() override;  // stops the re-discovery thread
   const std::string& error() const { return error_; }
 
   int num_shards() const { return num_shards_; }
   int num_partitions() const { return num_partitions_; }
+  size_t num_replicas(int shard) const {
+    return shard >= 0 && shard < num_shards_ ? pools_[shard].num_replicas()
+                                             : 0;
+  }
 
   // ---- GraphAPI ----
   int64_t NumNodes() const override { return num_nodes_; }
@@ -88,6 +115,7 @@ class RemoteGraph : public GraphAPI {
   void SampleNodeWithSrc(const uint64_t* src, int n, int count,
                          uint64_t* out) const override;
   void GetNodeType(const uint64_t* ids, int n, int32_t* out) const override;
+  bool GetNodeWeight(const uint64_t* ids, int n, float* out) const override;
 
   void SampleNeighbor(const uint64_t* ids, int n, const int32_t* etypes,
                       int net, int count, uint64_t default_id,
@@ -126,6 +154,18 @@ class RemoteGraph : public GraphAPI {
                                  const int32_t* fids, int nf) const override;
 
  private:
+  // One pass of discovery from the recorded source (tcp registry LIST or
+  // flat-dir scan) into shard -> replica address lists. False when the
+  // source is unreachable (callers keep the current pools). timeout_ms
+  // bounds the registry dial: Init passes the full client timeout, the
+  // background loop a short one so ~RemoteGraph never waits long for an
+  // in-flight re-LIST against a blackholed registry.
+  bool Discover(
+      std::map<int, std::vector<std::pair<std::string, int>>>* shards,
+      int timeout_ms) const;
+  // Background poll: Discover + per-shard ConnPool::Update.
+  void RediscoverLoop();
+
   inline int ShardOf(uint64_t id) const {
     return static_cast<int>((id % static_cast<uint64_t>(num_partitions_)) %
                             static_cast<uint64_t>(num_shards_));
@@ -158,6 +198,15 @@ class RemoteGraph : public GraphAPI {
   std::string error_;
   int num_shards_ = 0, num_partitions_ = 1;
   int retries_ = 3, timeout_ms_ = 5000, quarantine_ms_ = 3000;
+
+  // discovery source recorded by Init for the periodic re-LIST
+  // (empty reg_host_ AND empty reg_dir_ = static shards=, no re-discovery)
+  std::string reg_host_;
+  int reg_port_ = 0;
+  std::string reg_dir_;
+  int rediscover_ms_ = 0;
+  std::thread rediscover_thread_;
+  std::atomic<bool> rediscover_stop_{false};
 
   int64_t num_nodes_ = 0, num_edges_ = 0;
   int32_t node_type_num_ = 0, edge_type_num_ = 0;

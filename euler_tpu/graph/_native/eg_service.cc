@@ -288,6 +288,14 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
       w.Arr(out);
       break;
     }
+    case kNodeWeight: {
+      int64_t n;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      std::vector<float> out(static_cast<size_t>(n));
+      if (r.ok()) engine_.GetNodeWeight(ids, static_cast<int>(n), out.data());
+      w.Arr(out);
+      break;
+    }
     case kSampleNeighbor: {
       int64_t n, net;
       const uint64_t* ids = r.Arr<uint64_t>(&n);

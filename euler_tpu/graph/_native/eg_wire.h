@@ -38,6 +38,10 @@ enum WireOp : uint8_t {
   kEdgeSparseFeature = 12,
   kBinaryFeature = 13,
   kEdgeBinaryFeature = 14,
+  // Beyond the reference's 13 RPCs: flat per-node sampling weights, so
+  // the device-graph exporter (build_node_sampler) composes with remote
+  // mode instead of requiring the whole graph embedded in one process.
+  kNodeWeight = 15,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap

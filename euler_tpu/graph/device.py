@@ -26,8 +26,11 @@ TPU-native design uploads the adjacency ONCE and samples on device:
 
 Everything returned is a dict of numpy arrays meant to live in
 ``state["consts"]`` — replicated (or sharded) over the mesh, aliased
-across steps by donation, free after the one-time upload. Export is
-local-mode: you need the whole graph in-process to upload it.
+across steps by donation, free after the one-time upload. Export works
+against local AND remote graphs: adjacency rides get_full_neighbor and
+the samplers ride node_weights/node_types, all of which scatter per
+shard in remote mode — so device-sampling training composes with a
+sharded TCP-registry cluster (tests/test_remote.py).
 """
 
 from __future__ import annotations
@@ -176,18 +179,41 @@ def _warn_float32_cum_resolution(n: int, where: str, kind: str) -> None:
         )
 
 
+def _export_node_arrays(graph, max_id: int, need_types: bool,
+                        chunk: int = 1 << 20):
+    """Chunked node_weights (+ node_types) export over [0, max_id]: keeps
+    each remote-mode RPC reply bounded (weights/types work in remote mode
+    too — one kNodeWeight/kNodeType scatter per shard per chunk), and
+    costs local mode nothing."""
+    w_parts, t_parts = [], []
+    for lo in range(0, max_id + 1, chunk):
+        ids = np.arange(lo, min(lo + chunk, max_id + 1), dtype=np.int64)
+        w_parts.append(graph.node_weights(ids))
+        if need_types:
+            t_parts.append(graph.node_types(ids))
+    weights = (
+        np.concatenate(w_parts) if w_parts else np.zeros(0, np.float32)
+    )
+    types = (
+        (np.concatenate(t_parts) if t_parts else np.zeros(0, np.int32))
+        if need_types
+        else None
+    )
+    return weights, types
+
+
 def build_node_sampler(graph, node_type: int = -1, max_id: int = 0) -> dict:
     """Weighted global root sampler for one node type (-1 = all types,
     type picked by weight sum first — reference compact_graph.cc:32-56;
     with-replacement draws over cum weights give exactly that marginal).
 
     Returns {"ids": [M] int32, "cum": [M] float32} over the matching
-    nodes, sorted by id for determinism.
+    nodes, sorted by id for determinism. Works against local AND remote
+    graphs (node_weights/node_types scatter per shard since round 3).
     """
     ids = np.arange(max_id + 1, dtype=np.int64)
-    weights = graph.node_weights(ids)
+    weights, types = _export_node_arrays(graph, max_id, node_type != -1)
     if node_type != -1:
-        types = graph.node_types(ids)
         mask = types == node_type
         ids, weights = ids[mask], weights[mask]
     keep = weights > 0
@@ -228,9 +254,17 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     if "packed" in adj and pallas_sampling.eligible(
         int(np.prod(jnp.shape(nodes))), count
     ):
-        seed = jax.random.randint(key, (), 0, jnp.iinfo(jnp.int32).max)
+        # two independent int31 words -> 62 bits of the key's entropy
+        # reach the core PRNG (a single int31 seed would birthday-collide
+        # across long runs, replaying identical on-core streams)
+        seed = jax.random.randint(key, (2,), 0, jnp.iinfo(jnp.int32).max)
         return pallas_sampling.sample_neighbor(adj, nodes, seed, count)
     nodes = jnp.asarray(nodes, dtype=jnp.int32)
+    # unknown ids sample the default node: negatives and past-the-slab
+    # ids map to the default row on BOTH paths (the kernel clamps the
+    # same way; a bare numpy-style wrap would send -2 to a real row)
+    n_rows = adj["nbr"].shape[0]
+    nodes = jnp.where(nodes < 0, n_rows - 1, jnp.minimum(nodes, n_rows - 1))
     cum = adj["cum"][nodes]                       # [M, W]
     u = jax.random.uniform(key, (*nodes.shape, count))
     # index = #thresholds strictly below u  (u < cum[0] -> 0, ...)
@@ -351,8 +385,7 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
     lookup (-1 for unknown/default)}.
     """
     all_ids = np.arange(max_id + 1, dtype=np.int64)
-    weights = graph.node_weights(all_ids)
-    types = graph.node_types(all_ids)
+    weights, types = _export_node_arrays(graph, max_id, need_types=True)
     type_table = np.full(max_id + 2, -1, dtype=np.int32)
     type_table[: max_id + 1] = types
 

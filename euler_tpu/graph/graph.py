@@ -111,6 +111,7 @@ class Graph:
         retries: int | None = None,
         timeout_ms: int | None = None,
         quarantine_ms: int | None = None,
+        rediscover_ms: int | None = None,
         cache_dir: str | None = None,
         config: str | None = None,
         init: str | None = None,
@@ -125,7 +126,7 @@ class Graph:
         known = {
             "directory", "files", "shard_idx", "shard_num", "mode",
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
-            "cache_dir", "init",
+            "rediscover_ms", "cache_dir", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -153,6 +154,9 @@ class Graph:
         retries = int(pick("retries", retries, 3))
         timeout_ms = int(pick("timeout_ms", timeout_ms, 5000))
         quarantine_ms = int(pick("quarantine_ms", quarantine_ms, 3000))
+        # mid-run registry re-LIST period (native RediscoverLoop); None =
+        # the native default (3000 ms with a registry, off for shards=)
+        rediscover_ms = pick("rediscover_ms", rediscover_ms, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         init = str(pick("init", init, "eager")).lower()
         if mode not in ("local", "remote"):
@@ -163,7 +167,8 @@ class Graph:
             directory=directory, files=files, shard_idx=shard_idx,
             shard_num=shard_num, registry=registry, shards=shards,
             retries=retries, timeout_ms=timeout_ms,
-            quarantine_ms=quarantine_ms, cache_dir=cache_dir,
+            quarantine_ms=quarantine_ms, rediscover_ms=rediscover_ms,
+            cache_dir=cache_dir,
         )
         self.mode = mode
         if init == "eager":
@@ -245,6 +250,8 @@ class Graph:
                 f";retries={retries};timeout_ms={timeout_ms}"
                 f";quarantine_ms={quarantine_ms}"
             )
+            if p["rediscover_ms"] is not None:
+                conf += f";rediscover_ms={int(p['rediscover_ms'])}"
             self._handle = self._lib.eg_remote_create(conf.encode())
             if not self._handle:
                 self._handle = None
@@ -281,6 +288,13 @@ class Graph:
             if self.mode == "remote"
             else 1
         )
+
+    def num_replicas(self, shard: int) -> int:
+        """Current replica count of one shard's connection pool (remote
+        mode) — observability for mid-run re-discovery."""
+        if self.mode != "remote":
+            return 1
+        return self._lib.eg_remote_replica_count(self._h, shard)
 
     def close(self) -> None:
         # touch _handle, not _h: closing a lazy graph must not connect it
@@ -358,19 +372,20 @@ class Graph:
         return out
 
     def node_weights(self, ids) -> np.ndarray:
-        """Per-node sampling weights (0 for unknown ids). Local mode only:
-        feeds the device-graph exporter, which needs the whole graph
-        in-process anyway."""
-        if self.mode != "local":
-            raise NotImplementedError(
-                "node_weights is local-mode only (device-graph export "
-                "needs the embedded engine)"
-            )
+        """Per-node sampling weights (0 for unknown ids). Works in both
+        modes: local reads the embedded engine; remote scatters a
+        kNodeWeight RPC per shard — so the device-graph exporter
+        (build_node_sampler / build_typed_node_sampler) composes with
+        sharded graphs. Raises when a shard cannot answer: a weight
+        silently read as 0 would bias the exported sampler (unlike the
+        query ops, which legitimately degrade to defaults)."""
         ids = _ids(ids)
         out = np.empty(len(ids), dtype=np.float32)
-        self._lib.eg_get_node_weight(
+        rc = self._lib.eg_get_node_weight(
             self._h, _ptr(ids, _U64P), len(ids), _ptr(out, _F32P)
         )
+        if rc != 0:
+            raise RuntimeError(self._lib.eg_last_error().decode())
         return out
 
     # ---- neighbor ops ----

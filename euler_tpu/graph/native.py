@@ -73,6 +73,7 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_remote_create, p, [c.c_char_p])
     _sig(L.eg_remote_shards, c.c_int, [p])
     _sig(L.eg_remote_partitions, c.c_int, [p])
+    _sig(L.eg_remote_replica_count, c.c_int, [p, c.c_int])
     _sig(
         L.eg_service_start,
         p,
@@ -98,7 +99,7 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_sample_edge, None, [p, c.c_int, c.c_int32, u64p, u64p, i32p])
     _sig(L.eg_sample_node_with_src, None, [p, u64p, c.c_int, c.c_int, u64p])
     _sig(L.eg_get_node_type, None, [p, u64p, c.c_int, i32p])
-    _sig(L.eg_get_node_weight, None, [p, u64p, c.c_int, f32p])
+    _sig(L.eg_get_node_weight, c.c_int, [p, u64p, c.c_int, f32p])
     _sig(
         L.eg_sample_neighbor,
         None,

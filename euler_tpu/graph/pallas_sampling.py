@@ -81,18 +81,57 @@ def _backend_ok(require_single_device: bool) -> bool:
     return True
 
 
+def _force_flag():
+    """Strictly parsed EULER_TPU_PALLAS_SAMPLING: True ("1"/"true"),
+    False ("0"/"false"), or None (unset/empty). Anything else —
+    "off", "no", "False " with a space — warns once and counts as
+    unset rather than silently force-enabling the kernel."""
+    raw = os.environ.get("EULER_TPU_PALLAS_SAMPLING")
+    if raw is None or raw == "":
+        return None
+    v = raw.strip().lower()
+    if v in ("1", "true"):
+        return True
+    if v in ("0", "false"):
+        return False
+    import warnings
+
+    warnings.warn(
+        f"EULER_TPU_PALLAS_SAMPLING={raw!r} is not one of 0/1/false/true"
+        " (case-insensitive); ignoring it",
+        stacklevel=3,
+    )
+    return None
+
+
 def available() -> bool:
     """True when the kernel path should auto-activate: TPU backend, one
     device (see SPMD note above), imports work, not overridden by env.
     EULER_TPU_PALLAS_SAMPLING=1 skips the single-device heuristic (e.g.
-    to force the kernel inside a manual shard_map) but still requires a
-    TPU backend with pallas importable — the kernel's primitives exist
+    to force the kernel inside a manual shard_map — see shard_map_adj in
+    this module for the supported wiring) but still requires a TPU
+    backend with pallas importable — the kernel's primitives exist
     nowhere else; =0 forces the XLA path."""
-    force = os.environ.get("EULER_TPU_PALLAS_SAMPLING")
+    force = _force_flag()
     if force is not None:
-        if force in ("0", "false", ""):
+        if not force:
             return False
-        return _backend_ok(require_single_device=False)
+        ok = _backend_ok(require_single_device=False)
+        if ok:
+            import jax
+
+            if len(jax.devices()) > 1:
+                import warnings
+
+                warnings.warn(
+                    "EULER_TPU_PALLAS_SAMPLING=1 with "
+                    f"{len(jax.devices())} devices: pallas_call does not"
+                    " partition under pjit — the forced kernel is only"
+                    " correct inside shard_map (use device.shard_adjacency"
+                    " / the models' mesh path, which wires it per-shard)",
+                    stacklevel=2,
+                )
+        return ok
     return _backend_ok(require_single_device=True)
 
 
@@ -153,7 +192,9 @@ def _kernel(ids_ref, seed_ref, pk_hbm, out_ref, pk_s, sem,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    pltpu.prng_seed(seed_ref[0])
+    # both words seed the core PRNG: 62 bits of caller entropy (a lone
+    # int31 word collides across long runs — ADVICE r2)
+    pltpu.prng_seed(seed_ref[0], seed_ref[1])
 
     def dma(slot, r, row):
         # one copy moves the node's whole 2K-row block (K nbr rows + K
@@ -232,9 +273,10 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     """[len(nodes), count] int32 weighted draws via the fused kernel.
 
     ``adj`` must carry the "packed" slab (models add it through
-    base.Model.add_sampling_consts when available()); ``seed`` is a
-    traced int32 scalar — callers with a PRNG key derive one via
-    jax.random.randint."""
+    base.Model.add_sampling_consts when available()); ``seed`` is one or
+    two traced int32 words (two preferred — both are fed to the core
+    PRNG; callers with a PRNG key derive them via jax.random.randint).
+    A scalar/1-word seed is zero-extended."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -249,11 +291,12 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     m = flat.shape[0]
     if m == 0:  # the kernel's prologue DMA needs >= 1 real row
         return jnp.zeros((*shape, count), jnp.int32)
-    # ids become raw DMA offsets in the kernel — clamp like the XLA
-    # path's OOB-clamping gathers so unknown ids land on the default row
-    # instead of reading past the slab (negatives clamp to row 0 rather
-    # than wrapping pythonically; upstream batch prep already clips >= 0)
-    flat = jnp.clip(flat, 0, n_rows - 1)
+    # ids become raw DMA offsets in the kernel — clamp so unknown ids
+    # (negative or past the slab) land on the DEFAULT row (n_rows-1)
+    # instead of reading out of bounds; device.sample_neighbor's XLA
+    # path applies the identical mapping, keeping build_adjacency's
+    # "unknown ids sample the default node" contract on both paths
+    flat = jnp.where(flat < 0, n_rows - 1, jnp.minimum(flat, n_rows - 1))
     # power-of-two stage size (sublane-aligned dynamic slices), floored
     # at 8, scaled down by K to keep the 2-slot scratch K-independent
     max_r = max(8, 1 << ((_MAX_R // k).bit_length() - 1))
@@ -272,6 +315,9 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
+    seed = jnp.atleast_1d(seed).astype(jnp.int32)
+    if seed.shape[0] < 2:
+        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.int32)])
     out = pl.pallas_call(
         functools.partial(
             _kernel, rows=rows, count=count, num_iters=mp // rows, k=k,
@@ -280,7 +326,7 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
         grid_spec=grid_spec,
     )(
         ids,
-        jnp.atleast_1d(seed).astype(jnp.int32),
+        seed[:2],
         packed,
     )
     return out[:m].reshape(*shape, count)

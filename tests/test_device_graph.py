@@ -764,7 +764,11 @@ def test_lasgnn_device_sampling_trains(graph):
     assert np.isfinite(emb).all()
 
 
-def test_remote_graph_rejected(graph, tmp_path):
+def test_remote_graph_export_matches_local(graph, tmp_path):
+    """Device-graph export composes with remote mode (round 3): the
+    samplers ride the kNodeWeight/kNodeType RPCs and the adjacency rides
+    get_full_neighbor, so a sharded service exports byte-identical slabs
+    to the embedded engine's."""
     from euler_tpu.graph.service import GraphService
     import euler_tpu
 
@@ -774,9 +778,24 @@ def test_remote_graph_rejected(graph, tmp_path):
     import os
 
     os.makedirs(d)
-    write_fixture(d, num_partitions=1)
-    with GraphService(d, 0, 1) as svc:
-        remote = euler_tpu.Graph(mode="remote", shards=[svc.address])
-        with pytest.raises(NotImplementedError, match="local"):
-            device.build_node_sampler(remote, -1, MAX_ID)
+    write_fixture(d, num_partitions=2)
+    with GraphService(d, 0, 2) as s0, GraphService(d, 1, 2) as s1:
+        remote = euler_tpu.Graph(
+            mode="remote", shards=[s0.address, s1.address]
+        )
+        for nt in (-1, 0, 1):
+            rs = device.build_node_sampler(remote, nt, MAX_ID)
+            ls = device.build_node_sampler(graph, nt, MAX_ID)
+            np.testing.assert_array_equal(rs["ids"], ls["ids"])
+            np.testing.assert_allclose(rs["cum"], ls["cum"], rtol=1e-6)
+        rt = device.build_typed_node_sampler(remote, 2, MAX_ID)
+        lt = device.build_typed_node_sampler(graph, 2, MAX_ID)
+        for k in ("ids", "off", "types"):
+            np.testing.assert_array_equal(rt[k], lt[k])
+        np.testing.assert_allclose(rt["cum"], lt["cum"], rtol=1e-6)
+        ra = device.build_adjacency(remote, [0, 1], MAX_ID)
+        la = device.build_adjacency(graph, [0, 1], MAX_ID)
+        for k in ("nbr", "deg", "sampleable"):
+            np.testing.assert_array_equal(ra[k], la[k])
+        np.testing.assert_allclose(ra["cum"], la["cum"], rtol=1e-6)
         remote.close()

@@ -140,6 +140,154 @@ def test_run_loop_shared_mode_tcp_registry(data_dir, tmp_path):
     assert rc == 0
 
 
+def test_device_sampling_trains_against_tcp_registry_shards(data_dir):
+    """The round-2 gap closed: device-resident sampling (adjacency +
+    samplers exported to HBM) composes with a SHARDED graph — the export
+    rides the kNodeWeight/kNodeType RPCs and get_full_neighbor scatters,
+    so the whole-graph-in-one-process restriction is gone."""
+    import euler_tpu
+    import jax
+    import numpy as np
+    import optax
+
+    from euler_tpu.models import SupervisedGraphSage
+
+    with RegistryServer() as reg:
+        with GraphService(data_dir, 0, 2, registry=reg.address), \
+             GraphService(data_dir, 1, 2, registry=reg.address):
+            g = euler_tpu.Graph(mode="remote", registry=reg.address)
+            assert g.num_shards == 2
+            model = SupervisedGraphSage(
+                label_idx=0, label_dim=4, metapath=[[0, 1]] * 2,
+                fanouts=[3, 2], dim=16, feature_idx=0, feature_dim=2,
+                max_id=16, device_features=True, device_sampling=True,
+            )
+            assert model.device_sampling
+            opt = optax.adam(0.05)
+            state = model.init_state(
+                jax.random.PRNGKey(0), g, g.sample_node(8, -1), opt
+            )
+            step = jax.jit(model.make_train_step(opt), donate_argnums=(0,))
+            losses = []
+            for _ in range(30):
+                batch = model.device_sample_batch(g.sample_node(8, -1))
+                state, loss, _ = step(state, batch)
+                losses.append(float(loss))
+            assert np.isfinite(losses).all()
+            assert np.mean(losses[-10:]) < np.mean(losses[:10])
+            g.close()
+
+
+def test_node_weights_raises_when_shard_unreachable(data_dir):
+    """Export queries must FAIL LOUDLY on a dead shard: a weight silently
+    read as 0 would make build_node_sampler drop that shard's every node
+    from the root sampler — biased training with no error anywhere."""
+    import euler_tpu
+
+    s0 = GraphService(data_dir, 0, 2)
+    s1 = GraphService(data_dir, 1, 2)
+    g = euler_tpu.Graph(
+        mode="remote", shards=[s0.address, s1.address],
+        retries=0, timeout_ms=300, quarantine_ms=100,
+    )
+    assert np.abs(g.node_weights([10, 11, 12])).sum() > 0  # healthy
+    s1.stop()
+    with pytest.raises(RuntimeError, match="unreachable"):
+        g.node_weights([10, 11, 12])  # 11 routes to the dead shard
+    # rows that never touch the dead shard still answer
+    assert g.node_weights([10, 12]).shape == (2,)
+    g.close()
+    s0.stop()
+
+
+def _poll(predicate, deadline_s: float, every_s: float = 0.1) -> bool:
+    end = time.time() + deadline_s
+    while time.time() < end:
+        if predicate():
+            return True
+        time.sleep(every_s)
+    return predicate()
+
+
+def test_shard_restart_on_new_port_is_rediscovered(data_dir):
+    """Mid-run re-discovery (reference ZK watch semantics,
+    rpc_manager.h:77-80 / zk_server_monitor.cc:252-260): a shard that
+    dies and comes back on a NEW port serves the same client again —
+    quarantine alone could never do this, the old pool only knows the
+    dead address."""
+    import euler_tpu
+
+    ids_shard1 = [11, 13, 15]  # (id % 2) % 2 == 1 with P=S=2
+    with RegistryServer(ttl_ms=500) as reg:
+        s0 = GraphService(data_dir, 0, 2, registry=reg.address)
+        s1 = GraphService(data_dir, 1, 2, registry=reg.address)
+        g = euler_tpu.Graph(
+            mode="remote", registry=reg.address, rediscover_ms=150,
+            timeout_ms=1000, quarantine_ms=300, retries=1,
+        )
+        baseline = g.get_dense_feature(ids_shard1, [0], [2])
+        assert np.abs(baseline).sum() > 0
+        old_port = s1.port
+        s1.stop()
+        # hold the old port so the restarted shard cannot reuse it
+        blocker = socket.socket()
+        blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        blocker.bind(("127.0.0.1", old_port))
+        blocker.listen(1)
+        try:
+            s1b = GraphService(data_dir, 1, 2, registry=reg.address)
+            assert s1b.port != old_port
+            # the client must re-learn the new address and serve shard-1
+            # rows again (zeros while only the dead address is known)
+            assert _poll(
+                lambda: np.allclose(
+                    g.get_dense_feature(ids_shard1, [0], [2]), baseline
+                ),
+                deadline_s=8.0,
+            ), "client never re-discovered the restarted shard"
+            s1b.stop()
+        finally:
+            blocker.close()
+        g.close()
+        s0.stop()
+
+
+def test_registry_restart_self_heals(data_dir):
+    """The TCP registry is soft state: when it dies and comes back (same
+    address), shard heartbeats re-REG on their next beat and the client's
+    periodic re-LIST keeps discovering — training never needs a rebuild.
+    (Blast radius documented in DEPLOY.md.)"""
+    import euler_tpu
+
+    reg = RegistryServer(ttl_ms=600)
+    port = reg.port
+    with GraphService(data_dir, 0, 2, registry=reg.address) as s0, \
+         GraphService(data_dir, 1, 2, registry=reg.address):
+        g = euler_tpu.Graph(
+            mode="remote", registry=reg.address, rediscover_ms=150,
+            timeout_ms=1000, quarantine_ms=300,
+        )
+        ids = [10, 11, 12, 13]
+        baseline = g.get_dense_feature(ids, [0], [2])
+        reg.stop()
+        # queries keep working while the registry is down: discovery is
+        # only a control plane, the data plane is direct to shards
+        np.testing.assert_allclose(
+            g.get_dense_feature(ids, [0], [2]), baseline
+        )
+        reg2 = RegistryServer(port=port, ttl_ms=600)
+        # shards re-REG on their next heartbeat (redial on send failure)
+        assert _poll(
+            lambda: set(query(reg2.address)) == {0, 1}, deadline_s=8.0
+        ), "shards never re-registered with the restarted registry"
+        np.testing.assert_allclose(
+            g.get_dense_feature(ids, [0], [2]), baseline
+        )
+        g.close()
+        reg2.stop()
+    del s0
+
+
 def test_registry_survives_hostile_connections():
     """The TCP registry parses commands from the network; garbage at the
     framing layer AND well-framed malformed command payloads must never
