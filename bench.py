@@ -467,6 +467,7 @@ def main() -> None:
     names.sort(key=lambda n: n == "ppi")
 
     tpu_error = None
+    platform = None
     # one gate for "JAX_PLATFORMS could resolve to the chip": the probe
     # branch and the watchdog's CPU-deadline scaling must never disagree
     tpu_possible = os.environ.get("JAX_PLATFORMS", "") in ("", "axon", "tpu")
@@ -508,7 +509,14 @@ def main() -> None:
     # JAX_PLATFORMS=cpu run; a healthy-but-slow CPU run must not be
     # reported as a wedged backend, so the default deadline scales up
     # (an explicit, parseable env deadline is honored as-is)
-    on_cpu = tpu_error is not None or not tpu_possible
+    # CPU three ways: probe failed (tpu_error), JAX_PLATFORMS forced a
+    # non-TPU backend, or the probe succeeded but the ambient backend IS
+    # cpu (TPU-less machine, JAX_PLATFORMS unset)
+    on_cpu = (
+        tpu_error is not None
+        or not tpu_possible
+        or platform not in ("tpu", "axon")
+    )
     if on_cpu and not explicit_deadline:
         deadline *= 3.0
 

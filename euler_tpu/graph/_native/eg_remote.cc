@@ -104,14 +104,6 @@ void ConnPool::Update(const std::vector<std::pair<std::string, int>>& addrs) {
   // in-flight Call snapshot releases them
 }
 
-std::vector<std::pair<std::string, int>> ConnPool::Addresses() const {
-  std::lock_guard<std::mutex> l(mu_);
-  std::vector<std::pair<std::string, int>> out;
-  out.reserve(replicas_.size());
-  for (const auto& r : replicas_) out.emplace_back(r->host, r->port);
-  return out;
-}
-
 size_t ConnPool::num_replicas() const {
   std::lock_guard<std::mutex> l(mu_);
   return replicas_.size();

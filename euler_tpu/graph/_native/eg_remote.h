@@ -60,7 +60,6 @@ class ConnPool {
   // no-op — a transiently empty/unreachable listing must never strand
   // the pool with zero replicas.
   void Update(const std::vector<std::pair<std::string, int>>& addrs);
-  std::vector<std::pair<std::string, int>> Addresses() const;
 
   size_t num_replicas() const;
 

@@ -164,19 +164,52 @@ def build_adjacency(
     }
 
 
-def _warn_float32_cum_resolution(n: int, where: str, kind: str) -> None:
-    """Device arrays are float32 (jax x32): beyond ~16M comparably-
-    weighted nodes, adjacent cumulative values collide at float32
-    resolution and the colliding nodes silently get probability 0.
-    (Adjacency rows never hit this: W stays small.)"""
-    if n > (1 << 24):
-        import warnings
+SEG = 1 << 16  # two-level draw segment size: device arrays are float32
+# (jax x32), so a SINGLE cumulative over ~16M comparably-weighted nodes
+# collides at float32 resolution (spacing near 1.0 is 2^-24) and tail
+# nodes silently get probability 0. Normalizing the cumulative WITHIN
+# 2^16-node segments keeps adjacent steps >= ~2^-16 (always
+# representable), and the segment-level cumulative only needs one value
+# per 65536 nodes — resolution holds to ~2^36 nodes. Adjacency rows
+# never hit this: W stays small.
 
-        warnings.warn(
-            f"{where}: {n} nodes exceeds float32 cumulative-weight "
-            f"resolution (~16M); tail nodes may be unsampleable — use "
-            f"host-side {kind} sampling for graphs this large"
-        )
+
+def _segment_cum(weights: np.ndarray, seg: int | None = None):
+    """(seg_cum [S] f32, within [M] f32): float64 host cumsum split into
+    ceil(M/seg) segments — seg_cum is the normalized cumulative over
+    segment totals, within is the cumulative normalized inside each
+    segment, last entry of every segment pinned to exactly 1.0 so u < 1
+    always lands in-segment. All weights must be > 0 (filtered by the
+    callers), so every segment total is positive."""
+    if seg is None:
+        seg = SEG  # module attr read at call time: tests shrink it
+    w = weights.astype(np.float64)
+    m = len(w)
+    starts = np.arange(0, m, seg)
+    seg_tot = np.add.reduceat(w, starts)
+    seg_cum = np.cumsum(seg_tot)
+    seg_cum /= seg_cum[-1]
+    seg_cum[-1] = 1.0
+    cum = np.cumsum(w)
+    base = np.concatenate([[0.0], np.cumsum(seg_tot)])
+    seg_idx = np.arange(m) // seg
+    within = (cum - base[seg_idx]) / seg_tot[seg_idx]
+    within[np.minimum(starts + seg, m) - 1] = 1.0  # pin segment ends
+    return seg_cum.astype(np.float32), within.astype(np.float32)
+
+
+def _bisect_first_ge(cum, lo, hi, u, steps: int):
+    """Vectorized first index in [lo, hi) with cum[idx] >= u (the
+    fixed-depth binary search shared by the two-level draws; lo/hi/u are
+    broadcast-compatible int32/float arrays)."""
+    M = max(int(cum.shape[0]), 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        go_right = cum[jnp.clip(mid, 0, M - 1)] < u
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return jnp.clip(lo, 0, M - 1)
 
 
 def _export_node_arrays(graph, max_id: int, need_types: bool,
@@ -207,9 +240,12 @@ def build_node_sampler(graph, node_type: int = -1, max_id: int = 0) -> dict:
     type picked by weight sum first — reference compact_graph.cc:32-56;
     with-replacement draws over cum weights give exactly that marginal).
 
-    Returns {"ids": [M] int32, "cum": [M] float32} over the matching
-    nodes, sorted by id for determinism. Works against local AND remote
-    graphs (node_weights/node_types scatter per shard since round 3).
+    Returns the two-level layout {"ids": [M] int32, "cum": [M] float32
+    (normalized within SEG-node segments), "seg_cum": [S] float32} over
+    the matching nodes, sorted by id for determinism — exact beyond the
+    ~16M-node float32 cliff a flat cumulative would hit (see SEG). Works
+    against local AND remote graphs (node_weights/node_types scatter per
+    shard since round 3).
     """
     ids = np.arange(max_id + 1, dtype=np.int64)
     weights, types = _export_node_arrays(graph, max_id, node_type != -1)
@@ -220,20 +256,33 @@ def build_node_sampler(graph, node_type: int = -1, max_id: int = 0) -> dict:
     ids, weights = ids[keep], weights[keep]
     if len(ids) == 0:
         raise ValueError(f"no nodes of type {node_type} with weight > 0")
-    _warn_float32_cum_resolution(len(ids), "build_node_sampler", "root")
-    cum = np.cumsum(weights.astype(np.float64))
-    cum /= cum[-1]
-    return {"ids": ids.astype(np.int32), "cum": cum.astype(np.float32)}
+    seg_cum, within = _segment_cum(weights)
+    return {
+        "ids": ids.astype(np.int32),
+        "cum": within,
+        "seg_cum": seg_cum,
+    }
 
 
 # ---- jit-side sampling ----
 
 
 def sample_node(sampler: dict, key, count: int):
-    """[count] int32 roots drawn weight-proportionally on device."""
-    u = jax.random.uniform(key, (count,))
-    idx = jnp.searchsorted(sampler["cum"], u)
-    idx = jnp.clip(idx, 0, sampler["ids"].shape[0] - 1)
+    """[count] int32 roots drawn weight-proportionally on device.
+
+    Two-level draw: u1 picks a SEG-node segment from seg_cum, u2
+    bisects that segment's within-normalized cumulative — P(node) =
+    (seg_total/total) * (w/seg_total) = w/total exactly, with every
+    float32 step representable regardless of graph size (see SEG)."""
+    k1, k2 = jax.random.split(key)
+    m = int(sampler["ids"].shape[0])
+    s = jnp.searchsorted(sampler["seg_cum"], jax.random.uniform(k1, (count,)))
+    s = jnp.clip(s, 0, sampler["seg_cum"].shape[0] - 1)
+    lo = (s * SEG).astype(jnp.int32)
+    hi = jnp.minimum(lo + SEG, m).astype(jnp.int32)
+    u2 = jax.random.uniform(k2, (count,))
+    steps = max(min(m, SEG).bit_length(), 1)
+    idx = _bisect_first_ge(sampler["cum"], lo, hi, u2, steps)
     return sampler["ids"][idx]
 
 
@@ -380,8 +429,12 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
     tf_euler euler_ops/sample_ops.py:39-67).
 
     Returns {"ids": [M] int32 (nodes sorted by type), "cum": [M] float32
-    (cumulative weights normalized WITHIN each type segment),
-    "off": [T+1] int32 segment offsets, "types": [N+2] int32 node-type
+    (cumulative weights normalized within SEG-node sub-segments of each
+    type — the same two-level layout as build_node_sampler, so a single
+    type beyond ~16M nodes keeps exact float32 draws), "off": [T+1]
+    int32 type offsets into ids, "seg_cum": [G] float32 (per-type
+    normalized cumulative over sub-segment totals), "tseg_off": [T+1]
+    int32 type offsets into seg_cum, "types": [N+2] int32 node-type
     lookup (-1 for unknown/default)}.
     """
     all_ids = np.arange(max_id + 1, dtype=np.int64)
@@ -391,25 +444,25 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
 
     ids_out: list[np.ndarray] = []
     cum_out: list[np.ndarray] = []
+    seg_out: list[np.ndarray] = []
     off = [0]
+    tseg_off = [0]
     empty_types = []
     for t in range(num_types):
         mask = (types == t) & (weights > 0)
         tids = all_ids[mask]
-        tw = weights[mask].astype(np.float64)
+        tw = weights[mask]
         if len(tids):
-            c = np.cumsum(tw)
-            c /= c[-1]
+            seg_cum, within = _segment_cum(tw)
         else:
-            c = np.zeros(0)
+            seg_cum, within = np.zeros(0, np.float32), np.zeros(0, np.float32)
             if (types == t).any():
                 empty_types.append(t)
-        _warn_float32_cum_resolution(
-            len(tids), f"build_typed_node_sampler (type {t})", "negative"
-        )
         ids_out.append(tids)
-        cum_out.append(c)
+        cum_out.append(within)
+        seg_out.append(seg_cum)
         off.append(off[-1] + len(tids))
+        tseg_off.append(tseg_off[-1] + len(seg_cum))
     if empty_types:
         import warnings
 
@@ -423,12 +476,17 @@ def build_typed_node_sampler(graph, num_types: int, max_id: int) -> dict:
         np.concatenate(ids_out) if off[-1] else np.zeros(0, np.int64)
     )
     cum_cat = (
-        np.concatenate(cum_out) if off[-1] else np.zeros(0, np.float64)
+        np.concatenate(cum_out) if off[-1] else np.zeros(0, np.float32)
+    )
+    seg_cat = (
+        np.concatenate(seg_out) if tseg_off[-1] else np.zeros(0, np.float32)
     )
     return {
         "ids": ids_cat.astype(np.int32),
         "cum": cum_cat.astype(np.float32),
         "off": np.asarray(off, dtype=np.int32),
+        "seg_cum": seg_cat,
+        "tseg_off": np.asarray(tseg_off, dtype=np.int32),
         "types": type_table,
     }
 
@@ -437,8 +495,10 @@ def sample_node_with_src(tsampler: dict, src, key, count: int):
     """[len(src), count] int32 negatives: each source draws from its own
     node type's weighted sampler (device analog of the native
     eg_sample_node_with_src). Sources of unknown/default type fall back
-    to type 0's segment. Bisection over the per-type cum segments —
-    fixed-depth binary search, fully vectorized."""
+    to type 0's segment. Two fixed-depth vectorized bisections per draw
+    (the two-level layout of build_typed_node_sampler): u1 picks a SEG
+    sub-segment within the type, u2 a node within the sub-segment —
+    float32-exact past the ~16M-nodes-per-type cliff."""
     src = jnp.asarray(src, dtype=jnp.int32).reshape(-1)
     t = tsampler["types"][src]
     # clamp out-of-range types into the sampler's range (mirrors the
@@ -448,22 +508,33 @@ def sample_node_with_src(tsampler: dict, src, key, count: int):
     # all-default (zero-feature) negatives
     num_types = tsampler["off"].shape[0] - 1
     t = jnp.clip(t, 0, num_types - 1)
-    lo = tsampler["off"][t][:, None].astype(jnp.int32)
-    hi = tsampler["off"][t + 1][:, None].astype(jnp.int32)
-    lo = jnp.broadcast_to(lo, (src.shape[0], count))
-    hi = jnp.broadcast_to(hi, (src.shape[0], count))
-    empty = hi <= lo
-    u = jax.random.uniform(key, (src.shape[0], count))
-    cum = tsampler["cum"]
-    M = max(int(cum.shape[0]), 1)
-    steps = max(M.bit_length(), 1)
-    for _ in range(steps):
-        active = lo < hi
-        mid = (lo + hi) // 2
-        go_right = cum[jnp.clip(mid, 0, M - 1)] < u
-        lo = jnp.where(active & go_right, mid + 1, lo)
-        hi = jnp.where(active & ~go_right, mid, hi)
-    idx = jnp.clip(lo, 0, M - 1)
+    shape = (src.shape[0], count)
+    node_lo = tsampler["off"][t][:, None].astype(jnp.int32)
+    node_hi = tsampler["off"][t + 1][:, None].astype(jnp.int32)
+    empty = jnp.broadcast_to(node_hi <= node_lo, shape)
+    k1, k2 = jax.random.split(key)
+    # level 1: sub-segment within the type's seg_cum span
+    g_lo = jnp.broadcast_to(
+        tsampler["tseg_off"][t][:, None].astype(jnp.int32), shape
+    )
+    g_hi = jnp.broadcast_to(
+        tsampler["tseg_off"][t + 1][:, None].astype(jnp.int32), shape
+    )
+    G = max(int(tsampler["seg_cum"].shape[0]), 1)
+    g = _bisect_first_ge(
+        tsampler["seg_cum"], g_lo, g_hi,
+        jax.random.uniform(k1, shape), max(G.bit_length(), 1),
+    )
+    # level 2: node within sub-segment g (sub-segments of a type are
+    # SEG-aligned from the type's node offset)
+    j = g - tsampler["tseg_off"][t][:, None]
+    lo = (node_lo + j * SEG).astype(jnp.int32)
+    hi = jnp.minimum(lo + SEG, node_hi).astype(jnp.int32)
+    M = max(int(tsampler["cum"].shape[0]), 1)
+    idx = _bisect_first_ge(
+        tsampler["cum"], lo, hi, jax.random.uniform(k2, shape),
+        max(min(M, SEG).bit_length(), 1),
+    )
     out = tsampler["ids"][idx]
     default = tsampler["types"].shape[0] - 1
     return jnp.where(empty, default, out)

@@ -160,6 +160,93 @@ def test_typed_negatives_match_src_type(graph, meta):
         assert abs((draws == i).mean() - probs[i]) < 0.03
 
 
+def test_two_level_sampler_multi_segment_exact(graph, monkeypatch):
+    """SEG shrunk to 4 so the tiny fixture spans several segments: the
+    two-level draw (segment pick x within-segment bisect) must reproduce
+    the host sampling weights — the default-SEG distribution tests only
+    ever exercise one segment."""
+    import jax
+
+    monkeypatch.setattr(device, "SEG", 4)
+    sampler = device.build_node_sampler(graph, -1, MAX_ID)
+    assert sampler["seg_cum"].shape[0] > 1
+    draws = np.asarray(
+        device.sample_node(sampler, jax.random.PRNGKey(5), 20000)
+    )
+    ids = np.arange(MAX_ID + 1, dtype=np.int64)
+    weights = graph.node_weights(ids)
+    probs = weights / weights.sum()
+    for i in ids[weights > 0]:
+        assert abs((draws == i).mean() - probs[i]) < 0.02
+
+
+def test_two_level_typed_negatives_multi_segment(graph, meta, monkeypatch):
+    """Same segment-boundary coverage for the typed negative sampler:
+    SEG=2 forces every type across multiple sub-segments, and each
+    source must still draw its own type at the host weights."""
+    import jax
+
+    monkeypatch.setattr(device, "SEG", 2)
+    ts = device.build_typed_node_sampler(graph, meta["node_type_num"], MAX_ID)
+    assert ts["seg_cum"].shape[0] > ts["off"].shape[0] - 1
+    src = graph.sample_node(64, -1)
+    negs = np.asarray(
+        device.sample_node_with_src(ts, src, jax.random.PRNGKey(1), 64)
+    )
+    src_types = graph.node_types(src)
+    for i in range(len(src)):
+        assert (graph.node_types(negs[i]) == src_types[i]).all()
+    for t in range(meta["node_type_num"]):
+        rows = np.flatnonzero(src_types == t)
+        if not len(rows):
+            continue
+        draws = negs[rows].reshape(-1)
+        ids = np.arange(MAX_ID + 1)
+        w = graph.node_weights(ids)
+        w[graph.node_types(ids) != t] = 0
+        probs = w / w.sum()
+        for i in ids[w > 0]:
+            assert abs((draws == i).mean() - probs[i]) < 0.03
+
+
+def test_two_level_sampler_beyond_float32_cliff():
+    """>2^24 comparably-weighted nodes — the regime where a FLAT float32
+    cumulative provably collides (adjacent values equal, tail nodes
+    silently unsampleable; the round-2 design warned and bailed here).
+    The two-level layout keeps every within-segment step representable
+    and the tail region draws at its exact probability."""
+    import jax
+
+    m = (1 << 24) + (1 << 20)  # 17.8M equal-weight nodes
+    tail = 1 << 20
+
+    class EqualWeightGraph:
+        def node_weights(self, ids):
+            return np.ones(len(ids), np.float32)
+
+        def node_types(self, ids):
+            return np.zeros(len(ids), np.int32)
+
+    # the flat cumulative this layout replaces DOES collide at this size
+    flat_tail = (
+        (np.arange(m - tail, m, dtype=np.float64) + 1) / m
+    ).astype(np.float32)
+    assert (np.diff(flat_tail) == 0).any()
+
+    sampler = device.build_node_sampler(EqualWeightGraph(), -1, m - 1)
+    # two-level: segment steps stay representable (strictly increasing)
+    seg = sampler["cum"][: (m // device.SEG) * device.SEG]
+    assert (np.diff(seg.reshape(-1, device.SEG), axis=1) > 0).all()
+    draws = np.asarray(
+        device.sample_node(sampler, jax.random.PRNGKey(7), 4096)
+    )
+    p_tail = tail / m
+    got = (draws >= m - tail).mean()
+    assert abs(got - p_tail) < 6 * np.sqrt(p_tail * (1 - p_tail) / 4096)
+    # the very tail is reachable, not probability-0
+    assert draws.max() >= m - tail
+
+
 def test_typed_negatives_clamp_out_of_range_types(graph):
     """Sources whose node type is outside the sampler's configured range
     clamp into it (like the TypedDense towers) — never the degenerate
