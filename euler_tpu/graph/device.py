@@ -286,6 +286,23 @@ def sample_node(sampler: dict, key, count: int):
     return sampler["ids"][idx]
 
 
+_KERNEL_MESH = None  # (Mesh, data_axis) set by set_kernel_mesh
+
+
+def set_kernel_mesh(mesh, axis: str = "data") -> None:
+    """Route eligible packed-slab draws through the Pallas kernel PER
+    SHARD of ``mesh`` (shard_map over ``axis``) — the SPMD composition
+    plain pjit cannot express. Call with None to clear. run_loop wires
+    this automatically when --device_sampling runs on a multi-device TPU
+    mesh (pallas_sampling.sharded_available())."""
+    global _KERNEL_MESH
+    _KERNEL_MESH = None if mesh is None else (mesh, axis)
+
+
+def kernel_mesh():
+    return _KERNEL_MESH
+
+
 def sample_neighbor(adj: dict, nodes, key, count: int):
     """[len(nodes), count] int32 weighted neighbor draws (replacement).
 
@@ -294,15 +311,28 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     the default row) yield the default node.
 
     When the adjacency carries a "packed" slab (added by
-    base.Model.add_sampling_consts on a single-device TPU backend), the
-    draw runs as one fused Pallas kernel instead of this op chain — same
+    base.Model.add_sampling_consts on a TPU backend), the draw runs as
+    one fused Pallas kernel instead of this op chain — same
     distribution, ~3x faster at bench dims (graph/pallas_sampling.py).
+    On a single device the kernel is called directly; under a mesh
+    registered via set_kernel_mesh it runs per-shard through shard_map.
     """
     from euler_tpu.graph import pallas_sampling
 
-    if "packed" in adj and pallas_sampling.eligible(
-        int(np.prod(jnp.shape(nodes))), count
-    ):
+    m = int(np.prod(jnp.shape(nodes)))
+    if "packed" in adj and _KERNEL_MESH is not None:
+        mesh, axis = _KERNEL_MESH
+        n_sh = mesh.shape[axis]
+        if m % n_sh == 0 and m > 0 and pallas_sampling.eligible(
+            m // n_sh, count
+        ):
+            seed = jax.random.randint(
+                key, (2,), 0, jnp.iinfo(jnp.int32).max
+            )
+            return pallas_sampling.sample_neighbor_sharded(
+                adj, nodes, seed, count, mesh, axis
+            )
+    elif "packed" in adj and pallas_sampling.eligible(m, count):
         # two independent int31 words -> 62 bits of the key's entropy
         # reach the core PRNG (a single int31 seed would birthday-collide
         # across long runs, replaying identical on-core streams)

@@ -135,6 +135,17 @@ def available() -> bool:
     return _backend_ok(require_single_device=True)
 
 
+def sharded_available() -> bool:
+    """True when the kernel can run PER-SHARD inside shard_map on this
+    backend: TPU with pallas importable, any device count. This is the
+    mesh-path activation check (device.set_kernel_mesh wires it);
+    available() stays the single-device auto-activation check —
+    pallas_call does not partition under plain pjit."""
+    if _force_flag() is False:
+        return False
+    return _backend_ok(require_single_device=False)
+
+
 def eligible(m: int, count: int) -> bool:
     """True when a draw of ``m`` source nodes x ``count`` fits the
     kernel's on-core budgets (ids in scalar prefetch / SMEM, [M, count]
@@ -330,3 +341,64 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
         packed,
     )
     return out[:m].reshape(*shape, count)
+
+
+def sample_neighbor_sharded(
+    adj: dict, nodes, seed, count: int, mesh, axis: str = "data",
+    draw_fn=None,
+):
+    """The kernel draw under SPMD: shard_map over ``mesh``'s ``axis``
+    with nodes batch-sharded and the (packed) adjacency replicated, so
+    each device runs ONE fused pallas_call on its local rows — the
+    composition plain pjit cannot express (pallas_call does not
+    partition). Per-shard seeds are decorrelated by folding in
+    axis_index, otherwise every shard would replay the same core-PRNG
+    stream against different rows.
+
+    ``nodes`` is flattened; its length must divide the axis size
+    (callers check — device.sample_neighbor falls back to the XLA chain
+    otherwise). ``draw_fn(adj, nodes, seed, count)`` defaults to the
+    kernel; tests inject an XLA-executable stand-in to exercise this
+    wiring on CPU meshes where the kernel's TPU primitives cannot run.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _sm  # jax >= 0.7 (check_vma kwarg)
+
+        def shard_map(f, **kw):
+            kw["check_vma"] = kw.pop("check_rep")
+            return _sm(f, **kw)
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    if draw_fn is None:
+        draw_fn = sample_neighbor
+    nodes = jnp.asarray(nodes, jnp.int32)
+    shape = nodes.shape
+    flat = nodes.reshape(-1)
+    seed = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
+    if seed.shape[0] < 2:
+        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.int32)])
+
+    def body(adj_l, nodes_l, seed_l):
+        ai = jax.lax.axis_index(axis).astype(jnp.int32)
+        # distinct per-shard words (golden-ratio odd constant; int32
+        # wraparound is fine — determinism is all that matters)
+        s = seed_l + (ai + 1) * jnp.int32(0x9E3779B1 - (1 << 32))
+        return draw_fn(adj_l, nodes_l, s, count)
+
+    out = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(), adj),
+            P(axis),
+            P(),
+        ),
+        out_specs=P(axis),
+        check_rep=False,
+    )(adj, flat, seed)
+    return out.reshape(*shape, count)

@@ -263,7 +263,12 @@ class Model:
 
         from euler_tpu.graph import pallas_sampling
 
-        use_pallas = pallas_sampling.available()
+        # pack for the fused kernel on a single-device TPU (auto) or when
+        # a kernel mesh is registered (per-shard shard_map path)
+        use_pallas = pallas_sampling.available() or (
+            device_graph.kernel_mesh() is not None
+            and pallas_sampling.sharded_available()
+        )
         adj = consts.setdefault("adj", {})
         for et in edge_type_sets:
             k = self.adj_key(et, sorted=sorted)
@@ -273,10 +278,9 @@ class Model:
                     sorted=sorted,
                 )
                 if use_pallas and not sorted:
-                    # single-device TPU: add the packed slab that routes
-                    # sample_neighbor through the fused Pallas kernel
-                    # (sorted slabs feed biased walks, which read
-                    # nbr/cum directly — no packing needed)
+                    # packed slab routes sample_neighbor through the
+                    # fused Pallas kernel (sorted slabs feed biased
+                    # walks, which read nbr/cum directly — no packing)
                     packed = pallas_sampling.pack_adjacency(adj[k])
                     if packed is not None:
                         adj[k]["packed"] = packed

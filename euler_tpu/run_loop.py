@@ -628,6 +628,24 @@ def main(argv=None) -> int:
     graph, services = build_graph(args)
     try:
         mesh = make_mesh(args.num_devices, model_parallel=args.model_parallel)
+        # multi-chip device sampling: keep the fused Pallas draw by
+        # running it per-shard inside shard_map (plain pjit cannot
+        # partition pallas_call) — no-op on non-TPU backends. Set OR
+        # cleared every run: a stale mesh from a prior main() in the
+        # same process must never route draws over the wrong mesh.
+        from euler_tpu.graph import device as device_graph
+        from euler_tpu.graph import pallas_sampling
+
+        device_graph.set_kernel_mesh(
+            mesh
+            if (
+                getattr(args, "device_sampling", False)
+                and mesh.size > 1
+                and pallas_sampling.sharded_available()
+            )
+            else None,
+            "data",
+        )
         model = build_model(args, graph)
         if args.mode == "train":
             run_train(model, graph, args, mesh)
@@ -636,6 +654,9 @@ def main(argv=None) -> int:
         else:
             run_save_embedding(model, graph, args, mesh)
     finally:
+        from euler_tpu.graph import device as device_graph
+
+        device_graph.set_kernel_mesh(None)
         for s in services:
             s.stop()
     return 0
