@@ -141,6 +141,72 @@ def _failure_line(name: str, error: str) -> dict:
     }
 
 
+def _chip_peaks():
+    """(peak_flops, peak_hbm_bytes_per_s) for this chip from its public
+    spec sheet, or (None, None) when unknown. Env overrides
+    EULER_TPU_PEAK_TFLOPS / EULER_TPU_PEAK_HBM_GBPS take precedence (set
+    both to teach the bench a new chip without a code change)."""
+    import jax
+
+    env_f = os.environ.get("EULER_TPU_PEAK_TFLOPS")
+    env_b = os.environ.get("EULER_TPU_PEAK_HBM_GBPS")
+    peak_f = float(env_f) * 1e12 if env_f else None
+    peak_b = float(env_b) * 1e9 if env_b else None
+    if peak_f is not None and peak_b is not None:
+        return peak_f, peak_b
+    kind = jax.devices()[0].device_kind.lower()
+    # bf16 peak / HBM BW per chip (public TPU spec sheets)
+    table = {
+        "v5 lite": (197e12, 819e9),
+        "v5litepod": (197e12, 819e9),
+        "v5e": (197e12, 819e9),
+        "v5p": (459e12, 2765e9),
+        "v6e": (918e12, 1640e9),
+        "v4": (275e12, 1228e9),
+    }
+    for k, (f, b) in table.items():
+        if k in kind:
+            return (peak_f or f), (peak_b or b)
+    return peak_f, peak_b
+
+
+def _roofline(compiled, step_time_ms: float):
+    """Achieved-vs-peak utilization from XLA's compile-time cost model:
+    {flops_per_step, hbm_bytes_per_step, achieved_tflops,
+    achieved_hbm_gbps, mfu, hbm_util}. The numbers are ANALYTICAL
+    (operand/output byte counts and op FLOPs from cost_analysis(), not
+    hardware counters) — right order of magnitude for a roofline
+    statement, not a profiler replacement. Scan/while bodies are counted
+    once by the cost model, so a scanned dispatch is already per-step.
+    Empty dict when the backend offers no cost analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+    except Exception:
+        return {}
+    if flops <= 0 and byts <= 0:
+        return {}
+    out = {
+        "flops_per_step": round(flops, 1),
+        "hbm_bytes_per_step": round(byts, 1),
+        "source": "xla_cost_analysis",
+    }
+    t = step_time_ms / 1e3
+    if t <= 0:
+        return out
+    peak_f, peak_b = _chip_peaks()
+    out["achieved_tflops"] = round(flops / t / 1e12, 4)
+    out["achieved_hbm_gbps"] = round(byts / t / 1e9, 2)
+    if peak_f:
+        out["mfu"] = round(flops / t / peak_f, 5)
+    if peak_b:
+        out["hbm_util"] = round(byts / t / peak_b, 5)
+    return out
+
+
 def _timed(fn, out_list):
     """Wrap fn to append its wall duration (ms) to out_list (thread-safe:
     list.append is atomic)."""
@@ -264,6 +330,14 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
         jax.block_until_ready(loss)
         device_times.append(time.perf_counter() - t1)
     device_step_ms = float(np.median(device_times)) * 1e3
+    # achieved-vs-peak for the host-path device step (lower() hits the
+    # jit cache — no recompile; donation is irrelevant, nothing executes)
+    try:
+        host_roofline = _roofline(
+            step_fn.lower(state, last_batch).compile(), device_step_ms
+        )
+    except Exception:
+        host_roofline = {}
 
     step_wall_ms = dt / measure * 1e3
     host_sample_ms = float(np.mean(sample_ms)) if sample_ms else 0.0
@@ -329,6 +403,16 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
         ds["step_wall_ms"] = round(ds_dt / (chunks * chunk_steps) * 1e3, 4)
         ds["setup_s"] = round(upload_s, 2)
         ds["final_loss"] = round(float(np.asarray(last)[-1]), 4)
+        try:
+            # XLA's cost model counts a while/scan BODY ONCE (it does not
+            # multiply by trip count) — verified: this dispatch's flops ~=
+            # the single-step host path's — so the scanned dispatch needs
+            # no chunk_steps division to be per-step
+            ds["roofline"] = _roofline(
+                scan.lower(state_ds, 0).compile(), ds["step_wall_ms"]
+            )
+        except Exception:
+            pass
         bogus = _implausible(ds["step_wall_ms"], last)
         if bogus:
             ds["implausible"] = bogus
@@ -441,6 +525,9 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
                 "sampling_hidden_by_prefetch": bool(
                     step_wall_ms < device_step_ms * 1.2
                 ),
+                # achieved vs peak (mfu / hbm_util) — the denominator for
+                # "is the step actually fast"; see PERF.md roofline notes
+                "roofline": host_roofline,
             },
             "trace_dir": trace_dir,
         },

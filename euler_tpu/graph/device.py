@@ -320,24 +320,29 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     from euler_tpu.graph import pallas_sampling
 
     m = int(np.prod(jnp.shape(nodes)))
-    if "packed" in adj and _KERNEL_MESH is not None:
-        mesh, axis = _KERNEL_MESH
-        n_sh = mesh.shape[axis]
-        if m % n_sh == 0 and m > 0 and pallas_sampling.eligible(
-            m // n_sh, count
-        ):
-            seed = jax.random.randint(
+    if "packed" in adj:
+        # kernel seed, shared by both routes: two independent int31
+        # words -> 62 bits of the key's entropy reach the core PRNG (a
+        # single int31 seed would birthday-collide across long runs,
+        # replaying identical on-core streams)
+        def kernel_seed():
+            return jax.random.randint(
                 key, (2,), 0, jnp.iinfo(jnp.int32).max
             )
-            return pallas_sampling.sample_neighbor_sharded(
-                adj, nodes, seed, count, mesh, axis
+
+        if _KERNEL_MESH is not None:
+            mesh, axis = _KERNEL_MESH
+            n_sh = mesh.shape[axis]
+            if m > 0 and m % n_sh == 0 and pallas_sampling.eligible(
+                m // n_sh, count
+            ):
+                return pallas_sampling.sample_neighbor_sharded(
+                    adj, nodes, kernel_seed(), count, mesh, axis
+                )
+        elif pallas_sampling.eligible(m, count):
+            return pallas_sampling.sample_neighbor(
+                adj, nodes, kernel_seed(), count
             )
-    elif "packed" in adj and pallas_sampling.eligible(m, count):
-        # two independent int31 words -> 62 bits of the key's entropy
-        # reach the core PRNG (a single int31 seed would birthday-collide
-        # across long runs, replaying identical on-core streams)
-        seed = jax.random.randint(key, (2,), 0, jnp.iinfo(jnp.int32).max)
-        return pallas_sampling.sample_neighbor(adj, nodes, seed, count)
     nodes = jnp.asarray(nodes, dtype=jnp.int32)
     # unknown ids sample the default node: negatives and past-the-slab
     # ids map to the default row on BOTH paths (the kernel clamps the

@@ -130,6 +130,13 @@ def test_force_env_parsed_strictly(monkeypatch):
 
 # ---- SPMD wiring (shard_map path; CPU-executable via draw_fn) ----
 
+multi_device = pytest.mark.skipif(
+    len(jax.devices()) < 4,
+    reason="needs >= 4 devices (CPU conftest mesh); on the single-chip "
+    "TPU run these would test a 1-device mesh — vacuous for "
+    "decorrelation, wrong for the divisibility fallback",
+)
+
 
 def _xla_draw(adj_l, nodes_l, seed, count):
     """XLA stand-in with the kernel's exact call signature
@@ -151,6 +158,7 @@ def _xla_draw(adj_l, nodes_l, seed, count):
     )
 
 
+@multi_device
 def test_sharded_draw_wiring_distribution(graph, adj):
     """sample_neighbor_sharded on a 4-device mesh (XLA stand-in body):
     batch-sharded nodes, replicated adjacency, per-source draw
@@ -196,6 +204,7 @@ def test_sharded_draw_wiring_distribution(graph, adj):
             assert abs(freq - p) < 6 * np.sqrt(p * (1 - p) / total) + 1e-3
 
 
+@multi_device
 def test_sharded_draw_decorrelates_shards(adj):
     """The same node replicated across the whole batch must NOT draw
     identical sequences on every shard — axis_index folds into the
@@ -215,6 +224,7 @@ def test_sharded_draw_decorrelates_shards(adj):
     assert not (out[0] == out[2]).all()
 
 
+@multi_device
 def test_kernel_mesh_routing(adj, monkeypatch):
     """device.sample_neighbor routes through the sharded path when a
     kernel mesh is registered and the local draw is eligible, and falls
