@@ -161,6 +161,7 @@ def _chip_peaks():
         "v5litepod": (197e12, 819e9),
         "v5e": (197e12, 819e9),
         "v5p": (459e12, 2765e9),
+        "v6 lite": (918e12, 1640e9),  # device_kind "TPU v6 lite"
         "v6e": (918e12, 1640e9),
         "v4": (275e12, 1228e9),
     }

@@ -1,0 +1,122 @@
+"""Host-path OpenMP core-scaling measurement for the native batch ops.
+
+The host sampling path claims to parallelize with host cores (the batch
+ops — sample_fanout, sample_neighbor, dense-feature gathers — run
+OpenMP parallel-for over rows, eg_engine.cc). This script measures that
+claim directly: per OMP_NUM_THREADS setting it re-execs itself in a
+subprocess (OpenMP sizes its thread pool from the env at library load),
+builds a synthetic graph at roughly bench dims, and times the batch ops.
+
+    python scripts/omp_scaling.py              # threads 1,2,4,8 (capped
+                                               # at the visible cores x2)
+    python scripts/omp_scaling.py --threads 1,4,16
+
+Prints one JSON line per setting plus a final summary table suitable
+for PERF.md. On a single-core host the extra-thread rows show
+contention, not scaling — run on a multi-core box for the real curve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def measure(num_nodes: int, batch: int, iters: int) -> dict:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import numpy as np
+
+    import euler_tpu
+    from euler_tpu.datasets import build_synthetic
+
+    cache = os.environ.get(
+        "EULER_TPU_BENCH_CACHE", "/tmp/euler_tpu_omp_scaling"
+    )
+    build_synthetic(
+        cache, num_nodes=num_nodes, avg_degree=15, feature_dim=50,
+        label_dim=8, multilabel=False,
+    )
+    g = euler_tpu.Graph(directory=cache)
+    roots = g.sample_node(batch, -1)
+    fanouts = [10, 10]
+    edge_types = [[0]] * len(fanouts)
+
+    def timed(fn):
+        fn()  # warm (page in, thread pool spin-up)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    fanout_ms = timed(lambda: g.sample_fanout(roots, edge_types, fanouts))
+    ids2 = g.sample_fanout(roots, edge_types, fanouts)[0][-1]
+    nbr_ms = timed(lambda: g.sample_neighbor(ids2, [0], 10))
+    feat_ms = timed(lambda: g.get_dense_feature(ids2, [1], [50]))
+    edges = batch * (fanouts[0] + fanouts[0] * fanouts[1])
+    return {
+        "omp_num_threads": int(os.environ.get("OMP_NUM_THREADS", 0)),
+        "sample_fanout_ms": round(fanout_ms, 3),
+        "fanout_edges_per_sec": round(edges / (fanout_ms / 1e3), 1),
+        "sample_neighbor_ms": round(nbr_ms, 3),
+        "dense_feature_ms": round(feat_ms, 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--threads", default=None,
+                    help="comma list; default 1,2,4,8 capped at 2x cores")
+    ap.add_argument("--num-nodes", type=int, default=56944)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        print(json.dumps(measure(args.num_nodes, args.batch, args.iters)),
+              flush=True)
+        return
+
+    cores = len(os.sched_getaffinity(0))
+    if args.threads:
+        threads = [int(t) for t in args.threads.split(",")]
+    else:
+        threads = [t for t in (1, 2, 4, 8) if t <= 2 * cores] or [1]
+    rows = []
+    for t in threads:
+        env = dict(os.environ, OMP_NUM_THREADS=str(t))
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--num-nodes", str(args.num_nodes), "--batch",
+             str(args.batch), "--iters", str(args.iters)],
+            env=env, capture_output=True, text=True,
+        )
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else ""
+        if r.returncode != 0 or not line:
+            print(json.dumps({"omp_num_threads": t,
+                              "error": r.stderr.strip()[-200:]}))
+            continue
+        row = json.loads(line)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if rows:
+        base = rows[0]["sample_fanout_ms"]
+        print(f"\nvisible cores: {cores}")
+        print("threads  fanout_ms  speedup  nbr_ms  feat_ms")
+        for r in rows:
+            print(
+                f"{r['omp_num_threads']:>7}  {r['sample_fanout_ms']:>9}"
+                f"  {base / r['sample_fanout_ms']:>7.2f}"
+                f"  {r['sample_neighbor_ms']:>6}"
+                f"  {r['dense_feature_ms']:>7}"
+            )
+
+
+if __name__ == "__main__":
+    main()
