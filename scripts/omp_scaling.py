@@ -106,7 +106,11 @@ def main() -> None:
         rows.append(row)
         print(json.dumps(row), flush=True)
     if rows:
-        base = rows[0]["sample_fanout_ms"]
+        # baseline = the fewest-threads row that succeeded (rows[0] would
+        # invert the curve under --threads 8,4,1 or a failed t=1 child)
+        base = min(rows, key=lambda r: r["omp_num_threads"])[
+            "sample_fanout_ms"
+        ]
         print(f"\nvisible cores: {cores}")
         print("threads  fanout_ms  speedup  nbr_ms  feat_ms")
         for r in rows:
