@@ -47,7 +47,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import threading
 import time
@@ -90,39 +89,25 @@ CONFIGS = {
     ),
 }
 
-_PROBE_SRC = (
-    "import jax, jax.numpy as jnp;"
-    "d = jax.devices();"
-    "(jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16))"
-    ".block_until_ready();"
-    "print(d[0].platform)"
-)
-
-
 def probe_backend(attempts: int, timeout_s: float, backoff_s: float):
-    """Initialize the ambient (TPU) backend in a killable subprocess.
+    """Initialize the ambient (TPU) backend in a killable subprocess
+    (euler_tpu.parallel.probe_backend_once — the ONE probe shared with
+    the training path's probe_backend_or_die, so relay-wedge handling
+    cannot drift between measurement and training), retrying with
+    backoff. Returns (platform, None) on success or (None, error
+    string) after all attempts fail; a timed-out child is killed, so a
+    hung backend init can neither block this process nor leave a child
+    holding the chip."""
+    from euler_tpu.parallel import probe_backend_once
 
-    Returns (platform, None) on success or (None, error string) after all
-    attempts fail. subprocess.run kills the child on timeout, so a hung
-    backend init can neither block this process nor leave a child holding
-    the chip.
-    """
     errs = []
     for a in range(attempts):
         if a:
             time.sleep(backoff_s)
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-        except subprocess.TimeoutExpired:
-            errs.append(f"attempt {a + 1}: init timed out after {timeout_s:.0f}s")
-            continue
-        if r.returncode == 0 and r.stdout.strip():
-            return r.stdout.strip().splitlines()[-1], None
-        tail = (r.stderr or r.stdout).strip().splitlines()
-        errs.append(f"attempt {a + 1}: rc={r.returncode} {tail[-1] if tail else ''}")
+        platform, err = probe_backend_once(timeout_s)
+        if platform is not None:
+            return platform, None
+        errs.append(f"attempt {a + 1}: {err}")
     return None, "; ".join(errs)
 
 

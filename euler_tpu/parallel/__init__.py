@@ -2,6 +2,8 @@ from euler_tpu.parallel.mesh import (
     batch_sharding,
     force_cpu_devices,
     honor_jax_platforms_env,
+    probe_backend_once,
+    probe_backend_or_die,
     make_mesh,
     pad_tables_for_mesh,
     replicated_sharding,
@@ -15,6 +17,8 @@ __all__ = [
     "batch_sharding",
     "force_cpu_devices",
     "honor_jax_platforms_env",
+    "probe_backend_once",
+    "probe_backend_or_die",
     "make_mesh",
     "pad_tables_for_mesh",
     "replicated_sharding",

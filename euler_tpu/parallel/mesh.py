@@ -37,6 +37,83 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "(jnp.ones((128, 128), jnp.bfloat16) @ jnp.ones((128, 128),"
+    " jnp.bfloat16)).block_until_ready();"
+    "print(d[0].platform)"
+)
+_probed_ok = False
+
+
+def probe_backend_once(timeout_s: float):
+    """One killable-subprocess attempt to init the ambient backend and
+    run a tiny matmul. Returns (platform, None) on success or
+    (None, error string). Shared by probe_backend_or_die and bench.py's
+    retry loop so relay-wedge handling cannot drift between the
+    training and measurement paths."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"init timed out after {timeout_s:.0f}s"
+    if r.returncode == 0 and r.stdout.strip():
+        return r.stdout.strip().splitlines()[-1], None
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return None, f"rc={r.returncode} {tail[-1] if tail else ''}"
+
+
+def probe_backend_or_die(timeout_s: float | None = None) -> None:
+    """Fail FAST with a recovery recipe instead of hanging forever when
+    the ambient TPU backend (the axon relay here) is wedged.
+
+    Backend init on a dead relay blocks at the C level — no traceback,
+    0% CPU, uninterruptible — so this runs init + a tiny matmul in a
+    KILLABLE subprocess first (the child exits before the parent
+    initializes, so it never holds the chip). Only probes when the
+    FIRST ambient platform could be a TPU (JAX_PLATFORMS unset, or
+    axon/tpu leading a comma list — "tpu,cpu" still inits TPU first);
+    explicit CPU runs and EULER_TPU_SKIP_BACKEND_PROBE=1 skip it, and a
+    SUCCESSFUL probe is cached per process (a failed one re-probes, so
+    callers that catch the error can re-check after the relay
+    recovers). Call from CLI entry points before any jax use
+    (run_loop.main and the examples do)."""
+    global _probed_ok
+    import os
+
+    if _probed_ok or os.environ.get("EULER_TPU_SKIP_BACKEND_PROBE") == "1":
+        return
+    first = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if first not in ("", "axon", "tpu"):
+        return
+    if timeout_s is None:
+        timeout_s = float(
+            os.environ.get("EULER_TPU_PROBE_TIMEOUT", 150)
+        )  # first TPU compile can take ~20-40 s; default is generous
+    platform, err = probe_backend_once(timeout_s)
+    if platform is not None:
+        _probed_ok = True
+        return
+    if "timed out" in (err or ""):
+        raise RuntimeError(
+            f"TPU backend unreachable: {err} (wedged relay/driver — "
+            "proceeding would hang this process forever at 0% CPU). "
+            "Options: retry later; JAX_PLATFORMS=cpu to run on CPU; "
+            "EULER_TPU_SKIP_BACKEND_PROBE=1 to skip this check; "
+            "EULER_TPU_PROBE_TIMEOUT=<s> to wait longer."
+        )
+    raise RuntimeError(
+        f"TPU backend probe failed: {err} — JAX_PLATFORMS=cpu runs on "
+        "CPU; EULER_TPU_SKIP_BACKEND_PROBE=1 skips this check."
+    )
+
+
 def force_cpu_devices(n_devices: int) -> None:
     """Force an n_devices-wide virtual CPU platform, overriding any ambient
     JAX_PLATFORMS / XLA_FLAGS (the environment here exports

@@ -613,10 +613,18 @@ def main(argv=None) -> int:
     )
     # Orbax/absl emit per-save INFO spam once a root handler exists.
     logging.getLogger("absl").setLevel(logging.WARNING)
-    from euler_tpu.parallel import honor_jax_platforms_env
+    from euler_tpu.parallel import (
+        honor_jax_platforms_env,
+        probe_backend_or_die,
+    )
 
     honor_jax_platforms_env()
     args = define_flags().parse_args(argv)
+    # after parse_args (so --help / usage errors stay instant) and
+    # before any jax use: a wedged TPU relay would otherwise hang
+    # backend init forever at 0% CPU with no traceback — fail fast with
+    # the recovery options
+    probe_backend_or_die()
     if args.coordinator_addr:
         import jax
 

@@ -28,6 +28,9 @@ import jax.numpy as jnp
 import optax
 
 import euler_tpu
+from euler_tpu.parallel import probe_backend_or_die
+
+probe_backend_or_die()  # fail fast (with options) on a wedged TPU relay
 from euler_tpu import ops
 from euler_tpu import train as train_lib
 from euler_tpu.datasets import REDDIT, build_reddit

@@ -18,6 +18,9 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import euler_tpu
+from euler_tpu.parallel import probe_backend_or_die
+
+probe_backend_or_die()  # fail fast (with options) on a wedged TPU relay
 from euler_tpu import train as train_lib
 from euler_tpu.datasets import PPI, build_ppi
 from euler_tpu.models import SupervisedGraphSage

@@ -47,8 +47,10 @@ def test_implausible_accepts_real_measurements(bench):
 def test_probe_backend_kills_hung_init(bench, monkeypatch):
     """A backend init that hangs must be killed at the timeout and
     reported, never block the bench process."""
+    from euler_tpu.parallel import mesh
+
     monkeypatch.setattr(
-        bench, "_PROBE_SRC", "import time; time.sleep(60)"
+        mesh, "_PROBE_SRC", "import time; time.sleep(60)"
     )
     platform, err = bench.probe_backend(
         attempts=2, timeout_s=0.5, backoff_s=0.0
@@ -58,8 +60,10 @@ def test_probe_backend_kills_hung_init(bench, monkeypatch):
 
 
 def test_probe_backend_reports_failing_init(bench, monkeypatch):
+    from euler_tpu.parallel import mesh
+
     monkeypatch.setattr(
-        bench, "_PROBE_SRC", "import sys; sys.exit(3)"
+        mesh, "_PROBE_SRC", "import sys; sys.exit(3)"
     )
     platform, err = bench.probe_backend(
         attempts=1, timeout_s=10.0, backoff_s=0.0
@@ -68,7 +72,9 @@ def test_probe_backend_reports_failing_init(bench, monkeypatch):
 
 
 def test_probe_backend_returns_platform(bench, monkeypatch):
-    monkeypatch.setattr(bench, "_PROBE_SRC", "print('cpu')")
+    from euler_tpu.parallel import mesh
+
+    monkeypatch.setattr(mesh, "_PROBE_SRC", "print('cpu')")
     platform, err = bench.probe_backend(
         attempts=1, timeout_s=30.0, backoff_s=0.0
     )
@@ -94,3 +100,26 @@ def test_watchdog_emits_json_on_hang():
     assert r.returncode == 2
     j = json.loads(r.stdout.strip().splitlines()[-1])
     assert "watchdog" in j["error"] and j["value"] == 0.0
+
+
+def test_probe_or_die_fails_fast_and_reprobes(monkeypatch):
+    """probe_backend_or_die: comma-list platforms with a TPU first still
+    probe; a FAILED probe is not cached (callers can re-check after the
+    relay recovers); explicit-CPU runs skip instantly."""
+    import pytest as _pytest
+
+    from euler_tpu.parallel import mesh
+
+    monkeypatch.setattr(mesh, "_probed_ok", False)
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    monkeypatch.setattr(mesh, "_PROBE_SRC", "import time; time.sleep(60)")
+    with _pytest.raises(RuntimeError, match="unreachable"):
+        mesh.probe_backend_or_die(timeout_s=0.5)
+    monkeypatch.setattr(mesh, "_PROBE_SRC", "print('tpu')")
+    mesh.probe_backend_or_die(timeout_s=30)  # re-probes, now passes
+    assert mesh._probed_ok
+    monkeypatch.setattr(mesh, "_probed_ok", False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(mesh, "_PROBE_SRC", "import time; time.sleep(60)")
+    mesh.probe_backend_or_die(timeout_s=0.5)  # skipped: CPU-pinned
+    assert not mesh._probed_ok
