@@ -174,3 +174,26 @@ def test_ppi_dress_rehearsal_at_scale(tmp_path):
     assert summary["evaluate_rc"] == 0
     s = summary["splits"]
     assert s["train"] > s["val"] > 0 and s["test"] > 0
+
+
+@pytest.mark.slow
+def test_reddit_dress_rehearsal_at_scale(tmp_path):
+    """DGL-npz-format files -> prepare_reddit -> reddit_main training ->
+    id-file evaluation, past the miniature fixtures (thousands of nodes,
+    602-dim features come from the full-size run recorded in README)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ))
+    import reddit_dress_rehearsal as rehearsal
+
+    summary = rehearsal.run(
+        num_nodes=5000, avg_degree=10, epochs=1, batch_size=200,
+        workdir=str(tmp_path),
+    )
+    assert summary["train_rc"] == 0
+    assert summary["evaluate_rc"] == 0
+    s = summary["splits"]
+    assert s["train"] > s["test"] > s["val"] > 0
