@@ -59,6 +59,10 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--graph_mode", default="local",
                    choices=["local", "remote", "shared"])
     p.add_argument("--registry", default="")
+    p.add_argument("--rediscover_ms", type=int, default=None, help=(
+        "mid-run registry re-LIST period for remote/shared clients "
+        "(default: native 3000 ms with a registry; 0 disables) — how a "
+        "shard restarted on a new address is re-learned mid-training"))
     p.add_argument("--service_host", default="", help=(
         "address this process's graph shard binds and advertises "
         "(shared mode). Empty = auto: the interface that routes to a "
@@ -160,6 +164,7 @@ def build_graph(args):
             mode="remote",
             registry=args.registry or None,
             shards=args.shards.split(",") if args.shards else None,
+            rediscover_ms=args.rediscover_ms,
         )
     else:  # shared: serve this process's shard, then connect remote
         if not args.registry:
@@ -280,7 +285,10 @@ def build_graph(args):
                     f"(need {args.num_processes}{stale_hint})"
                 )
             time.sleep(0.1)
-        graph = euler_tpu.Graph(mode="remote", registry=args.registry)
+        graph = euler_tpu.Graph(
+            mode="remote", registry=args.registry,
+            rediscover_ms=args.rediscover_ms,
+        )
     return graph, services
 
 

@@ -252,6 +252,49 @@ def test_shard_restart_on_new_port_is_rediscovered(data_dir):
         s0.stop()
 
 
+@pytest.mark.slow
+def test_repeated_shard_restart_cycles_under_load(data_dir):
+    """Resilience soak: four kill/restart cycles of alternating shards
+    while the same client keeps querying — INCLUDING during the window
+    when the shard is dead (those queries must degrade to defaults, not
+    wedge or poison the pool) — then rediscovery + quarantine +
+    heartbeat TTL must converge the client back to full data EVERY
+    cycle, no client rebuild."""
+    import euler_tpu
+
+    with RegistryServer(ttl_ms=400) as reg:
+        svcs = {
+            i: GraphService(data_dir, i, 2, registry=reg.address)
+            for i in range(2)
+        }
+        g = euler_tpu.Graph(
+            mode="remote", registry=reg.address, rediscover_ms=100,
+            timeout_ms=800, quarantine_ms=200, retries=1,
+        )
+        try:
+            ids = list(range(10, 17))
+            baseline = g.get_dense_feature(ids, [0], [2])
+            assert np.abs(baseline).sum() > 0
+            for cycle in range(4):
+                s = cycle % 2
+                svcs[s].stop()
+                # queries against the half-dead cluster: dead-shard rows
+                # degrade to zeros, the call itself must come back
+                during = g.get_dense_feature(ids, [0], [2])
+                assert during.shape == baseline.shape
+                svcs[s] = GraphService(data_dir, s, 2, registry=reg.address)
+                assert _poll(
+                    lambda: np.allclose(
+                        g.get_dense_feature(ids, [0], [2]), baseline
+                    ),
+                    deadline_s=10.0,
+                ), f"client never reconverged after restart cycle {cycle}"
+        finally:
+            g.close()
+            for svc in svcs.values():
+                svc.stop()
+
+
 def test_registry_restart_self_heals(data_dir):
     """The TCP registry is soft state: when it dies and comes back (same
     address), shard heartbeats re-REG on their next beat and the client's
