@@ -312,3 +312,29 @@ def test_packed_layout_matches_slabs(adj):
     np.testing.assert_array_equal(cum_lanes[:, :w], adj["cum"])
     assert (nbr_lanes[:, w:] == n - 1).all()
     assert (cum_lanes[:, w:] == 1.0).all()
+
+
+def test_two_level_root_sampler_distribution_at_scale(graph, monkeypatch):
+    """Random-graph analog of the fixture-level multi-segment test:
+    non-uniform node weights, SEG shrunk to 16 so the 300-node sampler
+    spans ~19 segments — the two-level draw (segment pick x in-segment
+    bisect) must reproduce every node's weight share."""
+    from euler_tpu.graph import device
+
+    monkeypatch.setattr(device, "SEG", 16)
+    s = device.build_node_sampler(graph, -1, N - 1)
+    assert s["seg_cum"].shape[0] > 10
+    draws = np.asarray(
+        device.sample_node(s, jax.random.PRNGKey(3), 60000)
+    )
+    ids = np.arange(N)
+    w = graph.node_weights(ids)
+    probs = w / w.sum()
+    for i in ids[w > 0]:
+        p = probs[i]
+        assert (
+            abs((draws == i).mean() - p)
+            < 6 * np.sqrt(p * (1 - p) / 60000) + 1e-3
+        ), i
+    # nothing outside the weighted support is ever drawn
+    assert set(np.unique(draws)) <= set(ids[w > 0].tolist())
