@@ -148,3 +148,28 @@ def test_lazy_concurrent_first_use_connects_once(fixture_dir):
         t.join()
     assert connects == [1]
     g.close()
+
+
+def test_rediscover_ms_via_config_file(tmp_path):
+    """rediscover_ms rides the same config-file surface as every other
+    client knob (and stays a known key, not a silently-dropped typo)."""
+    from euler_tpu.graph.registry import RegistryServer
+    from euler_tpu.graph.service import GraphService
+    from tests.fixture_graph import write_fixture
+
+    d = str(tmp_path / "g")
+    import os
+
+    os.makedirs(d)
+    write_fixture(d, num_partitions=1)
+    with RegistryServer() as reg, GraphService(d, 0, 1,
+                                               registry=reg.address):
+        p = tmp_path / "client.ini"
+        p.write_text(
+            f"mode = remote\nregistry = {reg.address}\n"
+            "rediscover_ms = 0\n"      # explicit off through the file
+        )
+        g = Graph(config=str(p))
+        assert g.num_shards == 1
+        assert len(g.sample_node(4, -1)) == 4
+        g.close()

@@ -51,33 +51,42 @@ def test_pack_adjacency_hbm_budget():
         pallas_sampling.pack_adjacency(small, max_bytes=100 * 1024 - 1)
         is None
     )
-    # W=200 packs as K=2 now (test_packed_layout_wide_slab); only
-    # W > MAX_W refuses, covered there
+    # W=200 packs as K=2 (test_packed_layout_k_boundaries); only
+    # W > MAX_W refuses (test_packed_layout_refuses_past_max_width)
 
 
-def test_packed_layout_wide_slab():
-    """W > 128 packs K = ceil(W/128) row-pairs per node (node-major: K
-    nbr rows then K cum rows); wider than MAX_W refuses."""
+def test_packed_layout_refuses_past_max_width():
+    """Wider than MAX_W keeps the XLA path (layout coverage for every
+    supported K lives in test_packed_layout_k_boundaries)."""
     ps = pallas_sampling
-    rng = np.random.default_rng(0)
-    n, w = 10, 200                      # -> K = 2
-    nbr = rng.integers(0, n, (n, w)).astype(np.int32)
-    cum = np.sort(rng.random((n, w)).astype(np.float32), axis=1)
-    cum[:, -1] = 1.0
-    packed = ps.pack_adjacency({"nbr": nbr, "cum": cum})
-    assert packed is not None and packed.shape == (4 * n, ps.LANES)
-    blk = packed.reshape(n, 4, ps.LANES)
-    got_nbr = blk[:, :2].reshape(n, 2 * ps.LANES)
-    got_cum = blk[:, 2:].reshape(n, 2 * ps.LANES).view(np.float32)
-    np.testing.assert_array_equal(got_nbr[:, :w], nbr)
-    np.testing.assert_array_equal(got_cum[:, :w], cum)
-    assert (got_cum[:, w:] == 1.0).all()    # pad: unreachable while u < 1
-    assert (got_nbr[:, w:] == n - 1).all()  # pad: default id
     too_wide = {
         "nbr": np.zeros((4, ps.MAX_W + 1), np.int32),
         "cum": np.ones((4, ps.MAX_W + 1), np.float32),
     }
     assert ps.pack_adjacency(too_wide) is None
+
+
+@pytest.mark.parametrize("w,k", [(129, 2), (200, 2), (300, 3), (512, 4)])
+def test_packed_layout_k_boundaries(w, k):
+    """Every K the kernel supports (up to MAX_W/128 = 4), including the
+    one-past-a-register width 129: node-major [K nbr rows, K cum rows]
+    blocks with exact pad semantics (pure host numpy, runs
+    everywhere)."""
+    ps = pallas_sampling
+    rng = np.random.default_rng(w)
+    n = 6
+    nbr = rng.integers(0, n, (n, w)).astype(np.int32)
+    cum = np.sort(rng.random((n, w)).astype(np.float32), axis=1)
+    cum[:, -1] = 1.0
+    packed = ps.pack_adjacency({"nbr": nbr, "cum": cum})
+    assert packed is not None and packed.shape == (2 * k * n, ps.LANES)
+    blk = packed.reshape(n, 2 * k, ps.LANES)
+    got_nbr = blk[:, :k].reshape(n, k * ps.LANES)
+    got_cum = blk[:, k:].reshape(n, k * ps.LANES).view(np.float32)
+    np.testing.assert_array_equal(got_nbr[:, :w], nbr)
+    np.testing.assert_array_equal(got_cum[:, :w], cum)
+    assert (got_cum[:, w:] == 1.0).all()
+    assert (got_nbr[:, w:] == n - 1).all()
 
 
 def test_pack_bakes_unsampleable_rows_to_default():
