@@ -339,7 +339,11 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
                 return pallas_sampling.sample_neighbor_sharded(
                     adj, nodes, kernel_seed(), count, mesh, axis
                 )
-        elif pallas_sampling.eligible(m, count):
+        elif pallas_sampling.eligible(m, count) and pallas_sampling.available():
+            # available() (single-device unless force-flagged) guards
+            # consts that carry a packed slab from a multi-device build:
+            # after set_kernel_mesh(None) the unsharded pallas_call under
+            # pjit would be the exact composition the module warns about
             return pallas_sampling.sample_neighbor(
                 adj, nodes, kernel_seed(), count
             )

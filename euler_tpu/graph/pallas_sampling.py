@@ -107,9 +107,15 @@ def _force_flag():
 def available() -> bool:
     """True when the kernel path should auto-activate: TPU backend, one
     device (see SPMD note above), imports work, not overridden by env.
-    EULER_TPU_PALLAS_SAMPLING=1 skips the single-device heuristic (e.g.
-    to force the kernel inside a manual shard_map — see shard_map_adj in
-    this module for the supported wiring) but still requires a TPU
+    EULER_TPU_PALLAS_SAMPLING=1 skips the single-device heuristic —
+    but only once a kernel mesh is registered
+    (device.set_kernel_mesh, which run_loop calls on the
+    --device_sampling path): on a multi-device backend with NO mesh
+    registered the flag warns and still returns False, because the
+    direct (non-shard_map) route would run an unsharded pallas_call
+    under pjit — silently wrong per-shard draws. Experts composing
+    their own shard_map call pallas_sampling.sample_neighbor directly,
+    which never consults this gate. The flag still requires a TPU
     backend with pallas importable — the kernel's primitives exist
     nowhere else; =0 forces the XLA path."""
     force = _force_flag()
@@ -120,17 +126,22 @@ def available() -> bool:
         if ok:
             import jax
 
-            if len(jax.devices()) > 1:
+            from euler_tpu.graph import device as _dg
+
+            if len(jax.devices()) > 1 and _dg.kernel_mesh() is None:
                 import warnings
 
                 warnings.warn(
                     "EULER_TPU_PALLAS_SAMPLING=1 with "
-                    f"{len(jax.devices())} devices: pallas_call does not"
-                    " partition under pjit — the forced kernel is only"
-                    " correct inside shard_map (use device.shard_adjacency"
-                    " / the models' mesh path, which wires it per-shard)",
+                    f"{len(jax.devices())} devices but no kernel mesh:"
+                    " pallas_call does not partition under pjit, so the"
+                    " force flag is ignored (XLA path) — register the"
+                    " mesh with device.set_kernel_mesh, as run_loop's"
+                    " --device_sampling path does, to wire the kernel"
+                    " per-shard",
                     stacklevel=2,
                 )
+                return False
         return ok
     return _backend_ok(require_single_device=True)
 

@@ -147,6 +147,30 @@ multi_device = pytest.mark.skipif(
 )
 
 
+@multi_device
+def test_force_env_multi_device_needs_kernel_mesh(monkeypatch):
+    """Force=1 on a multi-device backend is honored only once a kernel
+    mesh is registered: without one the direct route would run an
+    unsharded pallas_call under pjit (silently wrong per-shard draws),
+    so available() warns and stays False (code-review r4)."""
+    from jax.sharding import Mesh
+
+    from euler_tpu.graph import device as dg
+
+    monkeypatch.setenv("EULER_TPU_PALLAS_SAMPLING", "1")
+    monkeypatch.setattr(
+        pallas_sampling, "_backend_ok", lambda require_single_device: True
+    )
+    assert dg.kernel_mesh() is None
+    with pytest.warns(UserWarning, match="no kernel mesh"):
+        assert not pallas_sampling.available()
+    dg.set_kernel_mesh(Mesh(np.array(jax.devices()[:4]), ("data",)), "data")
+    try:
+        assert pallas_sampling.available()
+    finally:
+        dg.set_kernel_mesh(None)
+
+
 def _xla_draw(adj_l, nodes_l, seed, count):
     """XLA stand-in with the kernel's exact call signature
     (adj, nodes, seed[2], count) — lets the shard_map wiring run on CPU
@@ -266,6 +290,51 @@ def test_kernel_mesh_routing(adj, monkeypatch):
         assert out.shape == (7, 5) and len(calls) == 1
     finally:
         dg.set_kernel_mesh(None)
+
+
+def test_packed_consts_without_mesh_take_xla_chain_when_unavailable(
+    monkeypatch,
+):
+    """Consts can carry a packed slab while available() is False (e.g.
+    set_kernel_mesh(None) on a multi-device backend, or
+    EULER_TPU_PALLAS_SAMPLING=0 set after packing): the direct-kernel
+    branch must NOT fire — the unsharded pallas_call under pjit is the
+    composition the module's SPMD note warns about (ADVICE r3)."""
+    import jax.numpy as jnp
+
+    from euler_tpu.graph import device as dg
+
+    n, w = 4, 3
+    nbr = np.tile(np.arange(1, w + 1, dtype=np.int32), (n, 1))
+    cum = np.tile(
+        np.array([0.25, 0.5, 1.0], np.float32), (n, 1)
+    )
+    adj = {
+        "nbr": jnp.asarray(nbr),
+        "cum": jnp.asarray(cum),
+        "sampleable": jnp.ones((n,), bool),
+        "packed": jnp.asarray(
+            pallas_sampling.pack_adjacency({"nbr": nbr, "cum": cum})
+        ),
+    }
+    kernel_calls = []
+    monkeypatch.setattr(
+        pallas_sampling,
+        "sample_neighbor",
+        lambda *a, **kw: kernel_calls.append(a) or None,
+    )
+    assert dg.kernel_mesh() is None
+    monkeypatch.setattr(pallas_sampling, "available", lambda: False)
+    out = dg.sample_neighbor(
+        adj, jnp.zeros((5,), jnp.int32), jax.random.PRNGKey(0), 6
+    )
+    assert out.shape == (5, 6) and not kernel_calls  # XLA chain taken
+    # converse: available() True routes the eligible draw to the kernel
+    monkeypatch.setattr(pallas_sampling, "available", lambda: True)
+    out = dg.sample_neighbor(
+        adj, jnp.zeros((5,), jnp.int32), jax.random.PRNGKey(0), 6
+    )
+    assert kernel_calls and out is None  # the fake kernel was called
 
 
 # ---- kernel tests (single-device TPU only) ----
