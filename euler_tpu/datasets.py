@@ -25,6 +25,44 @@ REDDIT = dict(num_nodes=232965, avg_degree=50, feature_dim=602, label_dim=41,
               multilabel=False)
 
 
+def _cache_begin(out_dir: str, params: str,
+                 protect_unmarked: bool = False) -> bool:
+    """Shared done-marker protocol for every synthetic builder. True =
+    a finished build with IDENTICAL params is already there (caller
+    returns immediately). False = stale/partial/absent: stale outputs
+    are cleared, the in-progress marker is written (so an interrupted
+    build is detected and regenerated next time), and the caller must
+    generate then call _cache_finish. ``protect_unmarked``: .dat
+    partitions with NO marker at all are a real converted dataset —
+    treated as cached rather than overwritten (build_synthetic's
+    contract)."""
+    os.makedirs(out_dir, exist_ok=True)
+    marker = os.path.join(out_dir, "done")
+    wip = os.path.join(out_dir, "synthetic-in-progress")
+    if os.path.exists(marker):
+        with open(marker) as f:
+            if f.read() == params:
+                return True
+    elif (
+        protect_unmarked
+        and not os.path.exists(wip)
+        and any(n.endswith(".dat") for n in os.listdir(out_dir))
+    ):
+        return True
+    with open(wip, "w") as f:
+        f.write(params)
+    for name in os.listdir(out_dir):
+        if name.endswith(".dat") or name in ("done", "meta.json"):
+            os.unlink(os.path.join(out_dir, name))
+    return False
+
+
+def _cache_finish(out_dir: str, params: str) -> None:
+    with open(os.path.join(out_dir, "done"), "w") as f:
+        f.write(params)
+    os.unlink(os.path.join(out_dir, "synthetic-in-progress"))
+
+
 def build_synthetic(
     out_dir: str,
     num_nodes: int,
@@ -39,7 +77,6 @@ def build_synthetic(
     """Write a synthetic graph as .dat partitions + meta.json (cached: a
     'done' marker records the generation params and skips regeneration only
     when they match). Returns out_dir."""
-    os.makedirs(out_dir, exist_ok=True)
     params = json.dumps(
         dict(num_nodes=num_nodes, avg_degree=avg_degree,
              feature_dim=feature_dim, label_dim=label_dim,
@@ -47,29 +84,8 @@ def build_synthetic(
              max_degree=max_degree, seed=seed),
         sort_keys=True,
     )
-    marker = os.path.join(out_dir, "done")
-    wip = os.path.join(out_dir, "synthetic-in-progress")
-    if os.path.exists(marker):
-        with open(marker) as f:
-            if f.read() == params:
-                return out_dir
-        # stale cache generated with different settings: rebuild
-        for name in os.listdir(out_dir):
-            if name.endswith(".dat") or name in ("done", "meta.json"):
-                os.unlink(os.path.join(out_dir, name))
-    elif os.path.exists(wip):
-        # a previous synthetic build was interrupted mid-write: the .dat
-        # partitions may be truncated — regenerate them
-        for name in os.listdir(out_dir):
-            if name.endswith(".dat") or name == "meta.json":
-                os.unlink(os.path.join(out_dir, name))
-    elif any(n.endswith(".dat") for n in os.listdir(out_dir)):
-        # .dat partitions but no synthetic marker (neither done nor
-        # in-progress): this is a real converted dataset — never overwrite
-        # it, use it as-is.
+    if _cache_begin(out_dir, params, protect_unmarked=True):
         return out_dir
-    with open(wip, "w") as f:
-        f.write(params)
     from euler_tpu.graph.convert import pack_block
 
     rng = np.random.default_rng(seed)
@@ -114,9 +130,7 @@ def build_synthetic(
         outs[nid % num_partitions].write(pack_block(node, meta))
     for o in outs:
         o.close()
-    with open(marker, "w") as f:
-        f.write(params)
-    os.unlink(wip)
+    _cache_finish(out_dir, params)
     return out_dir
 
 
@@ -131,8 +145,15 @@ def build_planted(
     num_partitions: int = 2,
     max_degree: int = 30,
     seed: int = 11,
+    alpha: float | None = None,
 ):
     """Planted-community graph: the convergence gate for supervised GNNs.
+
+    ``alpha`` switches the degree distribution from
+    Poisson(avg_degree).clip(1, max_degree) to the heavy-tailed power
+    law of ``powerlaw_degrees`` (d_cap = max_degree) — the form the
+    max_degree-truncation cost study trains on: same planted labels,
+    same centroids, but hub nodes whose slab rows must truncate.
 
     Each node belongs to one of ``num_communities`` hidden communities;
     its label (float_feature slot 0, one-hot) IS the community, its input
@@ -164,7 +185,13 @@ def build_planted(
     by_comm = [
         np.flatnonzero(communities == c) for c in range(num_communities)
     ]
-    degrees = rng.poisson(avg_degree, num_nodes).clip(1, max_degree)
+    if alpha is None:
+        degrees = rng.poisson(avg_degree, num_nodes).clip(1, max_degree)
+    else:
+        degrees = powerlaw_degrees(
+            num_nodes, num_nodes * avg_degree, alpha, rng,
+            d_cap=max_degree,
+        )
     neighbors = []
     for nid in range(num_nodes):
         d = degrees[nid]
@@ -188,19 +215,11 @@ def build_planted(
              num_communities=num_communities, feature_dim=feature_dim,
              avg_degree=avg_degree, intra_p=intra_p, noise=noise,
              num_partitions=num_partitions, max_degree=max_degree,
-             seed=seed),
+             seed=seed, alpha=alpha),
         sort_keys=True,
     )
-    marker = os.path.join(out_dir, "done")
-    if os.path.exists(marker) and open(marker).read() == params:
+    if _cache_begin(out_dir, params):
         return out_dir, info
-
-    wip = os.path.join(out_dir, "synthetic-in-progress")
-    with open(wip, "w") as f:
-        f.write(params)
-    for name in os.listdir(out_dir):
-        if name.endswith(".dat") or name in ("done", "meta.json"):
-            os.unlink(os.path.join(out_dir, name))
     from euler_tpu.graph.convert import pack_block
 
     meta = {
@@ -240,10 +259,140 @@ def build_planted(
         outs[nid % num_partitions].write(pack_block(node, meta))
     for o in outs:
         o.close()
-    with open(marker, "w") as f:
-        f.write(params)
-    os.unlink(wip)
+    _cache_finish(out_dir, params)
     return out_dir, info
+
+
+def powerlaw_degrees(
+    num_nodes: int, num_edges: int, alpha: float, rng,
+    d_min: int = 1, d_cap: int | None = None,
+):
+    """[num_nodes] int64 out-degrees from a discrete power law
+    P(d) ~ d^-alpha (inverse-transform Pareto, d >= d_min, capped at
+    ``d_cap`` or num_nodes/4), then scaled so the total lands within
+    ~1% of ``num_edges``. Real Reddit's degree distribution is
+    heavy-tailed with mean ~490 over 233k nodes; alpha in [1.6, 2.2]
+    reproduces that max/mean shape (see scripts/reddit_heavytail.py)."""
+    if alpha <= 1.0:
+        raise ValueError(
+            f"powerlaw_degrees needs alpha > 1 (got {alpha}): the "
+            "inverse-transform exponent -1/(alpha-1) is undefined at 1 "
+            "and flips sign below it (degenerating to all-d_min rows)"
+        )
+    if d_cap is None:
+        d_cap = max(d_min + 1, num_nodes // 4)
+    u = rng.random(num_nodes)
+    d = d_min * (1.0 - u) ** (-1.0 / (alpha - 1.0))
+    d = np.minimum(d, d_cap)
+    # multiplicative rescale to the target edge count; iterate because
+    # the cap bites harder as the scale grows
+    for _ in range(16):
+        total = d.sum()
+        if abs(total - num_edges) <= 0.01 * num_edges:
+            break
+        d = np.minimum(np.maximum(d * (num_edges / total), d_min), d_cap)
+    return np.maximum(d.round(), d_min).astype(np.int64)
+
+
+def build_powerlaw(
+    out_dir: str,
+    num_nodes: int,
+    num_edges: int,
+    feature_dim: int,
+    label_dim: int,
+    alpha: float = 1.8,
+    multilabel: bool = False,
+    num_partitions: int = 4,
+    seed: int = 17,
+    progress_every: int = 0,
+) -> str:
+    """Heavy-tailed synthetic graph at a REAL edge budget: power-law
+    out-degrees (``powerlaw_degrees``) with targets drawn preferentially
+    (p ~ degree), so in-degrees are heavy-tailed too — the degree shape
+    build_synthetic's Poisson(avg_degree).clip(max_degree) deliberately
+    avoids and real Reddit (~233k nodes x ~114M directed edges, mean
+    ~490, hub degrees in the tens of thousands) actually has. Weights
+    are 1.0 like real Reddit. This is the graph the max_degree
+    truncation questions must be answered on: an untruncated device
+    slab would be [N, max_observed_degree] and is not buildable, which
+    is exactly the regime the exact (alias) device sampler exists for.
+
+    Edges land in a node's dict keyed by str(id), so duplicate targets
+    dedupe (true degree can fall slightly under the draw). Cached via
+    the same done-marker protocol as build_synthetic. Returns out_dir.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    params = json.dumps(
+        dict(kind="powerlaw", num_nodes=num_nodes, num_edges=num_edges,
+             feature_dim=feature_dim, label_dim=label_dim, alpha=alpha,
+             multilabel=multilabel, num_partitions=num_partitions,
+             seed=seed),
+        sort_keys=True,
+    )
+    if _cache_begin(out_dir, params):
+        return out_dir
+    from euler_tpu.graph.convert import pack_block
+
+    rng = np.random.default_rng(seed)
+    degrees = powerlaw_degrees(num_nodes, num_edges, alpha, rng)
+    # preferential targets: p ~ degree, drawn by inverse-CDF per node
+    cum = np.cumsum(degrees.astype(np.float64))
+    cum /= cum[-1]
+    meta = {
+        "node_type_num": 1,
+        "edge_type_num": 1,
+        "node_uint64_feature_num": 0,
+        "node_float_feature_num": 2,
+        "node_binary_feature_num": 0,
+        "edge_uint64_feature_num": 0,
+        "edge_float_feature_num": 0,
+        "edge_binary_feature_num": 0,
+    }
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    outs = [
+        open(os.path.join(out_dir, "part_%d.dat" % p), "wb")
+        for p in range(num_partitions)
+    ]
+    for nid in range(num_nodes):
+        d = int(degrees[nid])
+        nbrs = np.searchsorted(cum, rng.random(d))
+        if multilabel:
+            labels = rng.integers(0, 2, label_dim).astype(float)
+        else:
+            labels = np.zeros(label_dim)
+            labels[rng.integers(0, label_dim)] = 1.0
+        node = {
+            "node_id": nid,
+            "node_type": 0,
+            "node_weight": 1.0,
+            "neighbor": {"0": {str(int(t)): 1.0 for t in nbrs}},
+            "uint64_feature": {},
+            "float_feature": {
+                "0": labels.tolist(),
+                "1": rng.standard_normal(feature_dim).round(3).tolist(),
+            },
+            "binary_feature": {},
+            "edge": [],
+        }
+        outs[nid % num_partitions].write(pack_block(node, meta))
+        if progress_every and nid and nid % progress_every == 0:
+            print(
+                "build_powerlaw: %d/%d nodes" % (nid, num_nodes),
+                flush=True,
+            )
+    for o in outs:
+        o.close()
+    _cache_finish(out_dir, params)
+    return out_dir
+
+
+# real Reddit's published scale: 232,965 nodes, ~114.6M directed edges
+# (mean degree ~492) — the shape scripts/reddit_heavytail.py measures
+REDDIT_HEAVYTAIL = dict(
+    num_nodes=232965, num_edges=114_600_000, feature_dim=602,
+    label_dim=41, alpha=1.8, multilabel=False,
+)
 
 
 def nearest_centroid_accuracy(info: dict, use_neighbors: bool) -> float:

@@ -13,6 +13,7 @@
 
 #include "eg_engine.h"
 #include "eg_registry.h"
+#include "eg_sampling.h"
 #include "eg_stats.h"
 #include "eg_remote.h"
 #include "eg_service.h"
@@ -226,6 +227,15 @@ void eg_sample_fanout(void* h, const uint64_t* ids, int n,
   API(h)->SampleFanout(ids, n, etypes_flat, etype_counts,
                                         counts, nhops, default_id, out_ids,
                                         out_w, out_t);
+}
+
+// Flat-CSR alias-table build for the device-side exact sampler (pure
+// function, no engine handle): offsets [num_rows+1], weights/prob
+// [offsets[num_rows]], alias row-LOCAL int32 indices. See
+// eg::BuildAliasRows.
+void eg_build_alias_csr(const int64_t* offsets, int64_t num_rows,
+                        const float* weights, float* prob, int32_t* alias) {
+  eg::BuildAliasRows(offsets, num_rows, weights, prob, alias);
 }
 
 void* eg_get_full_neighbor(void* h, const uint64_t* ids, int n,

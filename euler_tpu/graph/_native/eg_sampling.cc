@@ -73,4 +73,54 @@ size_t SearchCumulative(const float* cum, size_t n, float r) {
   return static_cast<size_t>(it - cum);
 }
 
+void BuildAliasRows(const int64_t* offsets, int64_t num_rows,
+                    const float* weights, float* prob, int32_t* alias) {
+#pragma omp parallel
+  {
+    // per-thread scratch reused across rows (heavy-tail rows reach
+    // tens of thousands of entries; reallocating per row would thrash)
+    std::vector<double> scaled;
+    std::vector<int32_t> small, large;
+#pragma omp for schedule(dynamic, 256)
+    for (int64_t r = 0; r < num_rows; ++r) {
+      const int64_t base = offsets[r];
+      const int64_t n = offsets[r + 1] - base;
+      if (n <= 0) continue;
+      double total = 0.0;
+      for (int64_t i = 0; i < n; ++i) total += weights[base + i];
+      if (total <= 0.0) {  // degenerate: uniform, like AliasTable
+        for (int64_t i = 0; i < n; ++i) {
+          prob[base + i] = 1.0f;
+          alias[base + i] = static_cast<int32_t>(i);
+        }
+        continue;
+      }
+      const double scale = static_cast<double>(n) / total;
+      scaled.resize(n);
+      small.clear();
+      large.clear();
+      for (int64_t i = 0; i < n; ++i) {
+        scaled[i] = weights[base + i] * scale;
+        (scaled[i] < 1.0 ? small : large)
+            .push_back(static_cast<int32_t>(i));
+      }
+      // defaults cover entries the loop leaves untouched
+      for (int64_t i = 0; i < n; ++i) {
+        prob[base + i] = 1.0f;
+        alias[base + i] = static_cast<int32_t>(i);
+      }
+      while (!small.empty() && !large.empty()) {
+        int32_t s = small.back();
+        small.pop_back();
+        int32_t l = large.back();
+        large.pop_back();
+        prob[base + s] = static_cast<float>(scaled[s]);
+        alias[base + s] = l;
+        scaled[l] -= 1.0 - scaled[s];
+        (scaled[l] < 1.0 ? small : large).push_back(l);
+      }
+    }
+  }
+}
+
 }  // namespace eg

@@ -57,6 +57,16 @@ class PrefixTable {
 // in [0, end[-1]); returns the index offset within the segment.
 size_t SearchCumulative(const float* cum, size_t n, float r);
 
+// Flat-CSR Walker/Vose build for the device-side EXACT sampler: row r's
+// entries live at [offsets[r], offsets[r+1]); fill prob[slot] (chance of
+// keeping the slot's own entry) and alias[slot] (ROW-LOCAL index of the
+// alternative) for every slot. Zero/negative-total rows fall back to
+// uniform (prob 1, alias self) — callers mask them with the engine's
+// unsampleable contract, exactly like the padded-slab path. Parallel
+// over rows (the device exporter calls it on multi-million-row CSRs).
+void BuildAliasRows(const int64_t* offsets, int64_t num_rows,
+                    const float* weights, float* prob, int32_t* alias);
+
 }  // namespace eg
 
 #endif  // EG_SAMPLING_H_

@@ -45,6 +45,35 @@ except Exception:  # pragma: no cover
     jnp = None
 
 
+def _fetch_flat_csr(graph, edge_types, max_id: int, chunk: int,
+                    sorted: bool = False):
+    """Chunked full-neighbor export shared by the slab and alias
+    builders: (counts [N+2] int64, nbr_flat int64, w_flat float32
+    contiguous, offsets [N+3] int64 with offsets[-1] == len(nbr_flat)).
+    Row max_id+1 (the default row) is always empty here; builders add
+    their own default semantics."""
+    n_rows = max_id + 2
+    et = list(edge_types)
+    counts_all = np.zeros(n_rows, dtype=np.int64)
+    nbr_parts: list[np.ndarray] = []
+    w_parts: list[np.ndarray] = []
+    for lo in range(0, max_id + 1, chunk):
+        ids = np.arange(lo, min(lo + chunk, max_id + 1), dtype=np.int64)
+        nbr, w, _, counts = graph.get_full_neighbor(ids, et, sorted=sorted)
+        counts_all[lo:lo + len(ids)] = counts
+        nbr_parts.append(nbr)
+        w_parts.append(w)
+    nbr_flat = (
+        np.concatenate(nbr_parts) if nbr_parts else np.zeros(0, np.int64)
+    )
+    w_flat = np.ascontiguousarray(
+        np.concatenate(w_parts) if w_parts else np.zeros(0), np.float32
+    )
+    offsets = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts_all, out=offsets[1:])
+    return counts_all, nbr_flat, w_flat, offsets
+
+
 def build_adjacency(
     graph,
     edge_types,
@@ -65,22 +94,8 @@ def build_adjacency(
     """
     n_rows = max_id + 2
     default = max_id + 1
-    et = list(edge_types)
-
-    counts_all = np.zeros(n_rows, dtype=np.int64)
-    nbr_parts: list[np.ndarray] = []
-    w_parts: list[np.ndarray] = []
-    for lo in range(0, max_id + 1, chunk):
-        ids = np.arange(lo, min(lo + chunk, max_id + 1), dtype=np.int64)
-        nbr, w, _, counts = graph.get_full_neighbor(ids, et, sorted=sorted)
-        counts_all[lo:lo + len(ids)] = counts
-        nbr_parts.append(nbr)
-        w_parts.append(w)
-    nbr_flat = (
-        np.concatenate(nbr_parts) if nbr_parts else np.zeros(0, np.int64)
-    )
-    w_flat = (
-        np.concatenate(w_parts) if w_parts else np.zeros(0, np.float32)
+    counts_all, nbr_flat, w_flat, offsets = _fetch_flat_csr(
+        graph, edge_types, max_id, chunk, sorted=sorted
     )
 
     W = int(counts_all.max()) if len(counts_all) else 0
@@ -92,8 +107,6 @@ def build_adjacency(
 
     # vectorized scatter into the padded slabs (no per-row Python loop:
     # real graphs have hundreds of thousands of rows)
-    offsets = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(counts_all, out=offsets[1:])
     rows = np.repeat(np.arange(n_rows), counts_all)
     cols = np.arange(len(nbr_flat)) - np.repeat(offsets[:-1], counts_all)
     keep = cols < W  # drop overflow entries; heavy-tail fix-up below
@@ -162,6 +175,115 @@ def build_adjacency(
         "deg": deg,
         "sampleable": sampleable,
     }
+
+
+def build_alias_adjacency(
+    graph,
+    edge_types,
+    max_id: int,
+    chunk: int = 65536,
+) -> dict:
+    """Export the adjacency restricted to ``edge_types`` as device-side
+    EXACT sampling structures: flat-CSR Walker alias tables, O(1) per
+    draw with NO max_degree truncation — the heavy-tail alternative to
+    build_adjacency's padded slab, whose width is the max observed
+    degree (unbuildable on power-law graphs where hubs reach tens of
+    thousands of neighbors; reference semantics being preserved:
+    CompactNode::SampleNeighbor draws exactly over ALL neighbors,
+    euler/core/compact_node.cc:42-101).
+
+    Returns {"off": [N+2] int32 row starts, "deg": [N+2] int32,
+    "nbr": [E] int32, "alias": [E] int32 (GLOBAL ids, prebaked so the
+    draw needs no second row-local hop), "prob": [E] float32,
+    "sampleable": [N+2] bool} with N = max_id + 1 and E = total edges.
+    Memory is O(E) — 12 bytes/edge vs the slab's O(N * max_degree) —
+    e.g. ~1.4 GB for a 114M-edge Reddit-scale graph. The alias build
+    itself runs in native code (eg_build_alias_csr, OpenMP over rows).
+    Unknown ids and the default row sample the default node, exactly
+    like build_adjacency."""
+    import ctypes
+
+    from euler_tpu.graph import native
+
+    n_rows = max_id + 2
+    default = max_id + 1
+    counts_all, nbr_flat, w_flat, offsets = _fetch_flat_csr(
+        graph, edge_types, max_id, chunk
+    )
+    e = len(nbr_flat)
+    if e >= 1 << 31:
+        raise ValueError(
+            f"alias adjacency needs int32 slots: {e} edges; shard the "
+            "graph first"
+        )
+    prob = np.ones(e, dtype=np.float32)
+    alias_local = np.zeros(e, dtype=np.int32)
+    if e:
+        L = native.lib()
+        L.eg_build_alias_csr(
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(n_rows),
+            w_flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            prob.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            alias_local.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+    row_base = np.repeat(offsets[:-1], counts_all)
+    alias_ids = (
+        nbr_flat[row_base + alias_local].astype(np.int32)
+        if e else np.zeros(0, np.int32)
+    )
+    # zero-total rows are UNSAMPLEABLE (host engine fills the default
+    # node); the native build already made their tables uniform, the
+    # mask keeps the contract
+    csum_z = np.concatenate(
+        [[0.0], np.cumsum(w_flat, dtype=np.float64)]
+    )
+    sums = csum_z[offsets[1:]] - csum_z[offsets[:-1]]
+    sampleable = (counts_all > 0) & (sums > 0)
+    sampleable[default] = False
+    return {
+        "off": offsets[:-1].astype(np.int32),
+        "deg": counts_all.astype(np.int32),
+        "nbr": nbr_flat.astype(np.int32),
+        "alias": alias_ids,
+        "prob": prob,
+        "sampleable": sampleable,
+    }
+
+
+def _alias_sample_neighbor(adj: dict, nodes, key, count: int):
+    """Exact CSR-alias draw: j ~ U[0, deg), keep nbr[off+j] with
+    prob[off+j] else alias[off+j]. Same distribution as the padded-slab
+    compare-sum draw but over the FULL neighbor list — no truncation —
+    at O(1) ops and 4 gathers per draw."""
+    n_rows = adj["off"].shape[0]
+    default = n_rows - 1
+    # tolerate plain-numpy consts (tests build them host-side; traced
+    # callers pass device arrays already)
+    offs, degs, probs, nbrs, aliases, ok_rows = (
+        jnp.asarray(adj[k])
+        for k in ("off", "deg", "prob", "nbr", "alias", "sampleable")
+    )
+    nodes = jnp.asarray(nodes, dtype=jnp.int32)
+    nodes = jnp.where(nodes < 0, default, jnp.minimum(nodes, default))
+    deg = degs[nodes]                              # [M]
+    off = offs[nodes]
+    k1, k2 = jax.random.split(key)
+    u1 = jax.random.uniform(k1, (*nodes.shape, count))
+    u2 = jax.random.uniform(k2, (*nodes.shape, count))
+    j = jnp.minimum(
+        (u1 * deg[..., None]).astype(jnp.int32),
+        jnp.maximum(deg[..., None] - 1, 0),
+    )
+    e = probs.shape[0]
+    if e == 0:  # no edges of these types at all: everything defaults
+        return jnp.full((*nodes.shape, count), default, jnp.int32)
+    # empty rows at the CSR's end have off == E; their draws are masked
+    # to the default below, so clamping the slot only prevents the OOB
+    slot = jnp.minimum(off[..., None] + j, e - 1)
+    pick = jnp.where(u2 < probs[slot], nbrs[slot], aliases[slot])
+    ok = ok_rows[nodes] & (deg > 0)
+    return jnp.where(ok[..., None], pick, default)
 
 
 SEG = 1 << 16  # two-level draw segment size: device arrays are float32
@@ -316,8 +438,15 @@ def sample_neighbor(adj: dict, nodes, key, count: int):
     distribution, ~3x faster at bench dims (graph/pallas_sampling.py).
     On a single device the kernel is called directly; under a mesh
     registered via set_kernel_mesh it runs per-shard through shard_map.
+
+    Alias adjacencies (build_alias_adjacency — flat-CSR alias tables,
+    exact over the full neighbor list, the heavy-tail form) dispatch on
+    their "off" key to the O(1) alias draw instead of the slab chain.
     """
     from euler_tpu.graph import pallas_sampling
+
+    if "off" in adj:
+        return _alias_sample_neighbor(adj, nodes, key, count)
 
     m = int(np.prod(jnp.shape(nodes)))
     if "packed" in adj:
@@ -651,6 +780,13 @@ def sample_fanout(adjs, roots, key, counts):
     adjs: one adjacency dict per hop (repeat the same dict for a
     homogeneous metapath). Returns [roots, hop1, hop2, ...] flat id
     arrays, hop h sized prod(counts[:h+1]) * len(roots).
+
+    Two-hop fanouts over packed slabs route through the CHAINED kernel
+    (pallas_sampling.sample_fanout2): both hops in one program, the
+    data-dependent hop-2 row DMAs hidden behind the next stage's hop-1
+    compute — directly on a single device, per-shard via shard_map when
+    a kernel mesh is registered. Everything else keeps the per-hop loop
+    (whose single-hop draws still use the kernel when eligible).
     """
     if len(adjs) != len(counts):
         raise ValueError(
@@ -658,6 +794,11 @@ def sample_fanout(adjs, roots, key, counts):
             f"adjacencies for {len(counts)} fanout counts"
         )
     roots = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
+
+    chained = _sample_fanout2_route(adjs, roots, key, counts)
+    if chained is not None:
+        return chained
+
     out = [roots]
     cur = roots
     for h, (adj, c) in enumerate(zip(adjs, counts)):
@@ -665,3 +806,49 @@ def sample_fanout(adjs, roots, key, counts):
         cur = sample_neighbor(adj, cur, k, c).reshape(-1)
         out.append(cur)
     return out
+
+
+def _sample_fanout2_route(adjs, roots, key, counts):
+    """[roots, hop1, hop2] via the chained kernel when this fanout
+    qualifies, else None (caller keeps the per-hop loop). Mirrors
+    sample_neighbor's routing: direct kernel on a single device
+    (available()), shard_map per-shard when a kernel mesh is
+    registered."""
+    from euler_tpu.graph import pallas_sampling
+
+    if len(adjs) != 2:
+        return None
+    a1, a2 = adjs
+    if "packed" not in a1 or "packed" not in a2:
+        return None
+    if a1["nbr"].shape[0] != a2["nbr"].shape[0]:
+        return None
+    f1, f2 = counts
+    m = int(roots.shape[0])
+    if m == 0:
+        return None
+    n_rows = a1["nbr"].shape[0]
+    k1 = a1["packed"].shape[0] // (2 * n_rows)
+    k2 = a2["packed"].shape[0] // (2 * n_rows)
+
+    def kernel_seed():
+        return jax.random.randint(key, (2,), 0, jnp.iinfo(jnp.int32).max)
+
+    if _KERNEL_MESH is not None:
+        mesh, axis = _KERNEL_MESH
+        n_sh = mesh.shape[axis]
+        if m % n_sh == 0 and pallas_sampling.eligible2(
+            m // n_sh, f1, f2, k1, k2
+        ):
+            h1, h2 = pallas_sampling.sample_fanout2_sharded(
+                a1, a2, roots, kernel_seed(), f1, f2, mesh, axis
+            )
+            return [roots, h1.reshape(-1), h2.reshape(-1)]
+    elif pallas_sampling.eligible2(
+        m, f1, f2, k1, k2
+    ) and pallas_sampling.available():
+        h1, h2 = pallas_sampling.sample_fanout2(
+            a1, a2, roots, kernel_seed(), f1, f2
+        )
+        return [roots, h1.reshape(-1), h2.reshape(-1)]
+    return None

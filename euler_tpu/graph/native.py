@@ -113,6 +113,11 @@ def lib() -> ctypes.CDLL:
             c.POINTER(u64p), c.POINTER(f32p), c.POINTER(i32p),
         ],
     )
+    _sig(
+        L.eg_build_alias_csr,
+        None,
+        [c.POINTER(c.c_int64), c.c_int64, f32p, f32p, i32p],
+    )
     _sig(L.eg_get_full_neighbor, p, [p, u64p, c.c_int, i32p, c.c_int, c.c_int])
     _sig(
         L.eg_get_top_k_neighbor,

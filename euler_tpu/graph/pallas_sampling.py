@@ -38,6 +38,25 @@ tests/test_pallas_sampling.py, TPU-only).
 SPMD note: pallas_call does not partition under pjit, so the kernel
 auto-activates only on a single-device TPU (``available()``); meshes
 keep the XLA path. Force on/off with EULER_TPU_PALLAS_SAMPLING=1/0.
+
+Chained two-hop variant: ``sample_fanout2`` fuses BOTH fanout hops into
+one program — each stage of root rows draws its hop-1 picks, async-
+copies them VMEM->SMEM so they can address HBM, and issues the
+data-dependent hop-2 row DMAs, which complete behind the NEXT stage's
+hop-1 compute (hop-2 processing runs one stage behind hop-1). This
+removes the second kernel dispatch and the hop-1 -> HBM -> hop-2
+round-trip of the per-hop path. Folding the FEATURE gather in as well
+was evaluated and rejected: a per-row DMA gather of the [B*f1*f2]-row
+feature matrix costs ~40 ns of issue per row (~2 ms at PPI dims) vs
+~0.49 ms for XLA's gather — see PERF.md.
+
+CPU validation: EULER_TPU_PALLAS_INTERPRET=1 routes every pallas_call
+through pallas' TPU interpret mode (emulated DMAs/semaphores on CPU;
+=races additionally turns on its DMA race detector). The emulated core
+PRNG returns zeros, so interpret-mode tests inject precomputed uniforms
+(the ``u``/``u1``/``u2`` arguments) — which also makes them EXACT:
+identical uniforms must reproduce the XLA path's picks bit-for-bit
+(tests/test_pallas_interpret.py). Hardware runs never inject.
 """
 
 from __future__ import annotations
@@ -157,6 +176,22 @@ def sharded_available() -> bool:
     return _backend_ok(require_single_device=False)
 
 
+def interpret_params():
+    """False (compile for real) unless EULER_TPU_PALLAS_INTERPRET opts
+    this process into pallas' TPU interpret mode: "1" emulates the
+    kernels on CPU, "races" also enables the emulator's DMA race
+    detector. Test-only — interpretation is orders of magnitude slower
+    than both the compiled kernel and the XLA chain, so nothing
+    auto-activates it; available() is unaffected (the interpret knob
+    changes how an explicit kernel call executes, not routing)."""
+    raw = os.environ.get("EULER_TPU_PALLAS_INTERPRET")
+    if raw not in ("1", "races"):
+        return False
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.InterpretParams(detect_races=(raw == "races"))
+
+
 def eligible(m: int, count: int) -> bool:
     """True when a draw of ``m`` source nodes x ``count`` fits the
     kernel's on-core budgets (ids in scalar prefetch / SMEM, [M, count]
@@ -207,12 +242,73 @@ def pack_adjacency(adj: dict, max_bytes: int = MAX_PACKED_BYTES):
     return packed
 
 
-def _kernel(ids_ref, seed_ref, pk_hbm, out_ref, pk_s, sem,
-            *, rows, count, num_iters, k):
+def _prng_uniform(rows):
+    """[rows, 1] 24-bit mantissa-exact uniform in [0, 1) from the core
+    PRNG (seeded once per kernel via pltpu.prng_seed)."""
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    bits = pltpu.bitcast(pltpu.prng_random_bits((rows, 1)), jnp.uint32)
+    return (bits >> 8).astype(jnp.int32).astype(jnp.float32) * (
+        1.0 / (1 << 24)
+    )
+
+
+def _stage_draw(slab_block, rows, k, count, next_u):
+    """[rows, count] int32 picks from one stage's slab rows (VMEM value,
+    [2k*rows, 128], node-major K nbr rows then K cum rows per node).
+    ``next_u(c)`` yields the [rows, 1] uniform for draw column c — the
+    core PRNG on hardware, an injected-uniform read under interpret
+    mode. Shared by the single-hop kernel and both hops of the chained
+    kernel, so the draw semantics cannot drift between them."""
     import jax
     import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+
+    both = slab_block.reshape(rows, 2 * k, LANES)
+    nbrs = [both[:, j, :] for j in range(k)]               # k x [rows, 128]
+    cums = [
+        pltpu.bitcast(both[:, k + j, :], jnp.float32) for j in range(k)
+    ]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
+    cols = []
+    for c in range(count):
+        u = next_u(c)
+        # rank over the whole (sorted) K*128-lane cumulative row
+        idx = jnp.sum((u >= cums[0]).astype(jnp.int32), axis=1,
+                      keepdims=True)
+        for j in range(1, k):
+            idx = idx + jnp.sum(
+                (u >= cums[j]).astype(jnp.int32), axis=1, keepdims=True
+            )
+        idx = jnp.minimum(idx, k * LANES - 1)
+        # select lane idx from the concatenated nbr rows: exactly one
+        # register's local lane matches (out-of-register locals match
+        # no lane and contribute 0)
+        val = jnp.sum(
+            jnp.where(lanes == idx, nbrs[0], 0), axis=1, keepdims=True
+        )
+        for j in range(1, k):
+            val = val + jnp.sum(
+                jnp.where(lanes == idx - j * LANES, nbrs[j], 0),
+                axis=1, keepdims=True,
+            )
+        cols.append(val)
+    # unsampleable/default rows already hold the default id in every
+    # neighbor lane (pack_adjacency), so the draw needs no mask here
+    return jnp.concatenate(cols, axis=1)
+
+
+def _kernel(ids_ref, seed_ref, pk_hbm, *rest,
+            rows, count, num_iters, k, with_u):
+    import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if with_u:
+        u_ref, out_ref, pk_s, sem = rest
+    else:
+        u_ref, (out_ref, pk_s, sem) = None, rest
 
     # both words seed the core PRNG: 62 bits of caller entropy (a lone
     # int31 word collides across long runs — ADVICE r2)
@@ -248,57 +344,42 @@ def _kernel(ids_ref, seed_ref, pk_hbm, out_ref, pk_s, sem,
             issue(jax.lax.rem(it + 1, 2), it + 1)
 
         wait(slot, it)
-        both = pk_s[slot].reshape(rows, 2 * k, LANES)
-        nbrs = [both[:, j, :] for j in range(k)]           # k x [rows, 128]
-        cums = [
-            pltpu.bitcast(both[:, k + j, :], jnp.float32) for j in range(k)
-        ]
-        lanes = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 1)
-        cols = []
-        for _c in range(count):
-            bits = pltpu.bitcast(
-                pltpu.prng_random_bits((rows, 1)), jnp.uint32
-            )
-            # 24-bit mantissa-exact uniform in [0, 1)
-            u = (bits >> 8).astype(jnp.int32).astype(jnp.float32) * (
-                1.0 / (1 << 24)
-            )
-            # rank over the whole (sorted) K*128-lane cumulative row
-            idx = jnp.sum((u >= cums[0]).astype(jnp.int32), axis=1,
-                          keepdims=True)
-            for j in range(1, k):
-                idx = idx + jnp.sum(
-                    (u >= cums[j]).astype(jnp.int32), axis=1, keepdims=True
-                )
-            idx = jnp.minimum(idx, k * LANES - 1)
-            # select lane idx from the concatenated nbr rows: exactly one
-            # register's local lane matches (out-of-register locals match
-            # no lane and contribute 0)
-            val = jnp.sum(
-                jnp.where(lanes == idx, nbrs[0], 0), axis=1, keepdims=True
-            )
-            for j in range(1, k):
-                val = val + jnp.sum(
-                    jnp.where(lanes == idx - j * LANES, nbrs[j], 0),
-                    axis=1, keepdims=True,
-                )
-            cols.append(val)
-        # unsampleable/default rows already hold the default id in every
-        # neighbor lane (pack_adjacency), so the draw needs no mask here
-        out_ref[pl.ds(it * rows, rows), :] = jnp.concatenate(cols, axis=1)
+        if with_u:
+            def next_u(c):
+                return u_ref[pl.ds(it * rows, rows), c:c + 1]
+        else:
+            def next_u(c):
+                return _prng_uniform(rows)
+        out_ref[pl.ds(it * rows, rows), :] = _stage_draw(
+            pk_s[slot], rows, k, count, next_u
+        )
         return 0
 
     jax.lax.fori_loop(0, num_iters, body, 0)
 
 
-def sample_neighbor(adj: dict, nodes, seed, count: int):
+def _two_word_seed(seed):
+    import jax.numpy as jnp
+
+    seed = jnp.atleast_1d(jnp.asarray(seed)).astype(jnp.int32)
+    if seed.shape[0] < 2:
+        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.int32)])
+    return seed[:2]
+
+
+def sample_neighbor(adj: dict, nodes, seed, count: int, u=None):
     """[len(nodes), count] int32 weighted draws via the fused kernel.
 
     ``adj`` must carry the "packed" slab (models add it through
     base.Model.add_sampling_consts when available()); ``seed`` is one or
     two traced int32 words (two preferred — both are fed to the core
     PRNG; callers with a PRNG key derive them via jax.random.randint).
-    A scalar/1-word seed is zero-extended."""
+    A scalar/1-word seed is zero-extended.
+
+    ``u`` (test-only, [len(nodes), count] float32 in [0, 1)): injected
+    uniforms replacing the core PRNG's — interpret-mode tests use them
+    to pin the kernel's picks EXACTLY to the XLA chain's semantics,
+    since the emulated PRNG returns zeros."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -325,33 +406,341 @@ def sample_neighbor(adj: dict, nodes, seed, count: int):
     rows = max_r if m >= max_r else max(8, 1 << (m - 1).bit_length())
     mp = ((m + rows - 1) // rows) * rows
     ids = jnp.pad(flat, (0, mp - m))
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),           # packed slab (HBM)
+    ]
+    args = [ids, _two_word_seed(seed), packed]
+    if u is not None:
+        u = jnp.pad(
+            jnp.asarray(u, jnp.float32).reshape(m, count),
+            ((0, mp - m), (0, 0)),
+        )
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.VMEM))
+        args.append(u)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # ids, seed
         grid=(1,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),       # packed slab (HBM)
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((2, 2 * k * rows, LANES), jnp.int32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    seed = jnp.atleast_1d(seed).astype(jnp.int32)
-    if seed.shape[0] < 2:
-        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.int32)])
     out = pl.pallas_call(
         functools.partial(
             _kernel, rows=rows, count=count, num_iters=mp // rows, k=k,
+            with_u=u is not None,
         ),
         out_shape=jax.ShapeDtypeStruct((mp, count), jnp.int32),
         grid_spec=grid_spec,
-    )(
-        ids,
-        seed[:2],
-        packed,
-    )
+        interpret=interpret_params(),
+    )(*args)
     return out[:m].reshape(*shape, count)
+
+
+def _shard_map():
+    """jax's shard_map across the 0.7 rename (check_rep -> check_vma);
+    callers pass check_rep and get whichever kwarg this jax expects."""
+    try:
+        from jax import shard_map as _sm  # jax >= 0.7 (check_vma kwarg)
+
+        def shard_map(f, **kw):
+            kw["check_vma"] = kw.pop("check_rep")
+            return _sm(f, **kw)
+
+        return shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map
+
+
+def eligible2(m: int, f1: int, f2: int, k1: int = 1, k2: int = 1) -> bool:
+    """True when a chained two-hop fanout of ``m`` roots x f1 x f2 over
+    K1/K2-row-pair slabs fits the fused kernel's budgets: root ids in
+    scalar prefetch (SMEM), both hop outputs whole in VMEM, and the
+    hop-2 scratch within its ~3 MB budget even at the MINIMUM stage
+    size of 8 rows (k2 * f1 * 8 <= 1536 — without this check a wide
+    hop-2 slab x large f1 would pass and then fail VMEM allocation at
+    compile time instead of falling back). Callers fall back to the
+    per-hop path (which may still use the single-hop kernel)
+    otherwise."""
+    return (
+        f1 <= MAX_COUNT
+        and f2 <= MAX_COUNT
+        and m <= MAX_M
+        and m * f1 <= MAX_OUT_ELEMS
+        and m * f1 * f2 <= MAX_OUT_ELEMS
+        and k2 * f1 * 8 <= 1536
+        and k1 <= MAX_W // LANES
+        and k2 <= MAX_W // LANES
+    )
+
+
+def _fanout2_kernel(ids_ref, seed_ref, pk1_hbm, pk2_hbm, *rest,
+                    rows, f1, f2, num_iters, k1, k2, with_u):
+    """Both fanout hops in one program. Per stage of ``rows`` roots:
+    hop-1 slab rows stream in (double-buffered, like _kernel), the f1
+    picks are drawn and written to out1, then async-copied VMEM->SMEM so
+    they can address HBM, and the rows*f1 data-dependent hop-2 row DMAs
+    are issued. Hop-2 processing runs ONE STAGE BEHIND hop-1: stage
+    it's hop-2 rows arrive while stage it+1's hop-1 draw computes, so
+    the dependent DMA latency hides behind compute instead of
+    serializing after it."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if with_u:
+        u1_ref, u2_ref, out1_ref, out2_ref, pk1_s, pk2_s, picks_s, \
+            sem1, sem2, semp = rest
+    else:
+        u1_ref = u2_ref = None
+        out1_ref, out2_ref, pk1_s, pk2_s, picks_s, sem1, sem2, semp = rest
+
+    pltpu.prng_seed(seed_ref[0], seed_ref[1])
+    rows2 = rows * f1
+
+    def dma1(slot, r, row):
+        return pltpu.make_async_copy(
+            pk1_hbm.at[pl.ds(row * 2 * k1, 2 * k1), :],
+            pk1_s.at[slot, pl.ds(2 * k1 * r, 2 * k1), :],
+            sem1.at[slot],
+        )
+
+    def dma2(slot, j, row):
+        return pltpu.make_async_copy(
+            pk2_hbm.at[pl.ds(row * 2 * k2, 2 * k2), :],
+            pk2_s.at[slot, pl.ds(2 * k2 * j, 2 * k2), :],
+            sem2.at[slot],
+        )
+
+    def issue1(slot, it):
+        base = it * rows
+        for r in range(rows):
+            dma1(slot, r, ids_ref[base + r]).start()
+
+    def wait1(slot, it):
+        base = it * rows
+        for r in range(rows):
+            dma1(slot, r, ids_ref[base + r]).wait()
+
+    def issue2(slot):
+        # picks_s holds THIS stage's picks (copied just before): they
+        # are in-slab ids (< pk2's row count — sample_fanout2 asserts
+        # both slabs share it), so no clamp is needed for the DMA
+        for j in range(rows2):
+            r, c = divmod(j, f1)
+            dma2(slot, j, picks_s[r, c]).start()
+
+    def wait2(slot):
+        # semaphore waits count BYTES, not descriptors: picks_s has
+        # moved on to the next stage by now, so re-deriving the issued
+        # src rows is impossible — wait on same-shaped descriptors
+        # (src row 0) instead, which decrements the same per-slot
+        # semaphore by the same per-copy size
+        for j in range(rows2):
+            dma2(slot, j, 0).wait()
+
+    def next_u1(it):
+        if with_u:
+            return lambda c: u1_ref[pl.ds(it * rows, rows), c:c + 1]
+        return lambda c: _prng_uniform(rows)
+
+    def next_u2(stage):
+        if with_u:
+            return lambda c: u2_ref[pl.ds(stage * rows2, rows2), c:c + 1]
+        return lambda c: _prng_uniform(rows2)
+
+    def process_hop2(slot, stage):
+        wait2(slot)
+        out2_ref[pl.ds(stage * rows2, rows2), :] = _stage_draw(
+            pk2_s[slot], rows2, k2, f2, next_u2(stage)
+        )
+
+    issue1(0, 0)
+
+    def body(it, _):
+        slot = jax.lax.rem(it, 2)
+
+        @pl.when(it + 1 < num_iters)
+        def _():
+            issue1(jax.lax.rem(it + 1, 2), it + 1)
+
+        wait1(slot, it)
+        picks = _stage_draw(pk1_s[slot], rows, k1, f1, next_u1(it))
+        out1_ref[pl.ds(it * rows, rows), :] = picks
+        cp = pltpu.make_async_copy(
+            out1_ref.at[pl.ds(it * rows, rows), :], picks_s, semp
+        )
+        cp.start()
+        cp.wait()
+        issue2(slot)
+
+        # NOTE on uniform ORDER vs the per-hop path: with the core PRNG
+        # (hardware), hop-2 uniforms for stage it-1 are drawn after
+        # hop-1 uniforms for stages <= it — a different position in the
+        # one PRNG stream than two sequential kernels would use. That
+        # changes sequences, not distributions (same independent
+        # stream), exactly like the kernel-vs-threefry difference the
+        # module docstring records. Injected-uniform runs are
+        # position-exact by construction.
+        @pl.when(it > 0)
+        def _():
+            process_hop2(jax.lax.rem(it + 1, 2), it - 1)
+
+        return 0
+
+    jax.lax.fori_loop(0, num_iters, body, 0)
+    process_hop2(
+        jax.lax.rem(num_iters - 1, 2), num_iters - 1
+    )
+
+
+def sample_fanout2(adj1: dict, adj2: dict, roots, seed, f1: int, f2: int,
+                   u1=None, u2=None):
+    """(hop1 [m, f1], hop2 [m*f1, f2]) int32 draws with BOTH hops fused
+    into one kernel program (see _fanout2_kernel). ``adj1``/``adj2`` may
+    be the same dict (homogeneous fanout) or differ (metapath); both
+    must carry "packed" slabs over the same id space. ``u1``/``u2`` are
+    the test-only injected uniforms (see sample_neighbor).
+
+    Reference semantics: two chained CompactNode::SampleNeighbor rounds
+    (euler/core/compact_node.cc:42-101) — identical per-hop draw
+    distribution to device.sample_fanout's per-hop path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_rows = adj1["nbr"].shape[0]
+    if adj2["nbr"].shape[0] != n_rows:
+        raise ValueError(
+            "sample_fanout2 needs both adjacencies over one id space: "
+            f"{n_rows} vs {adj2['nbr'].shape[0]} rows"
+        )
+    pk1, pk2 = adj1["packed"], adj2["packed"]
+    k1 = pk1.shape[0] // (2 * n_rows)
+    k2 = pk2.shape[0] // (2 * n_rows)
+    roots = jnp.asarray(roots, jnp.int32).reshape(-1)
+    m = roots.shape[0]
+    if m == 0:
+        return (
+            jnp.zeros((0, f1), jnp.int32),
+            jnp.zeros((0, f2), jnp.int32),
+        )
+    # same unknown-id contract as sample_neighbor: clamp to the default
+    # row rather than DMA out of bounds
+    roots = jnp.where(
+        roots < 0, n_rows - 1, jnp.minimum(roots, n_rows - 1)
+    )
+    # stage size: power-of-two (sublane-aligned out1 slices), sized so
+    # the hop-2 scratch (2 slots x 2*k2*R*f1 rows) stays ~<= 3 MB and
+    # the SMEM pick buffer (R x f1 ids) stays ~<= 8 KB
+    r_max = min(
+        _MAX_R // k1,
+        max(1, 1536 // (k2 * f1)),
+        max(1, 2048 // f1),
+    )
+    r_max = max(8, 1 << (r_max.bit_length() - 1))
+    rows = r_max if m >= r_max else max(8, 1 << (m - 1).bit_length())
+    mp = ((m + rows - 1) // rows) * rows
+    ids = jnp.pad(roots, (0, mp - m), constant_values=n_rows - 1)
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),           # hop-1 slab (HBM)
+        pl.BlockSpec(memory_space=pl.ANY),           # hop-2 slab (HBM)
+    ]
+    args = [ids, _two_word_seed(seed), pk1, pk2]
+    with_u = u1 is not None
+    if (u1 is None) != (u2 is None):
+        raise ValueError("inject both u1 and u2 or neither")
+    if with_u:
+        u1 = jnp.pad(
+            jnp.asarray(u1, jnp.float32).reshape(m, f1),
+            ((0, mp - m), (0, 0)),
+        )
+        u2 = jnp.pad(
+            jnp.asarray(u2, jnp.float32).reshape(m * f1, f2),
+            ((0, (mp - m) * f1), (0, 0)),
+        )
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ]
+        args += [u1, u2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # root ids, seed
+        grid=(1,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, 2 * k1 * rows, LANES), jnp.int32),
+            pltpu.VMEM((2, 2 * k2 * rows * f1, LANES), jnp.int32),
+            pltpu.SMEM((rows, f1), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out1, out2 = pl.pallas_call(
+        functools.partial(
+            _fanout2_kernel, rows=rows, f1=f1, f2=f2,
+            num_iters=mp // rows, k1=k1, k2=k2, with_u=with_u,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, f1), jnp.int32),
+            jax.ShapeDtypeStruct((mp * f1, f2), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret_params(),
+    )(*args)
+    return out1[:m], out2[:m * f1]
+
+
+def sample_fanout2_sharded(
+    adj1: dict, adj2: dict, roots, seed, f1: int, f2: int, mesh,
+    axis: str = "data", draw_fn=None,
+):
+    """sample_fanout2 under SPMD: shard_map over ``mesh``'s ``axis``
+    with roots batch-sharded, both (packed) adjacencies replicated, and
+    per-shard seeds decorrelated via axis_index — the same wiring as
+    sample_neighbor_sharded (see its docstring for why plain pjit
+    cannot express this). ``roots`` length must divide the axis size;
+    device.sample_fanout checks before routing here. ``draw_fn``
+    defaults to sample_fanout2; tests inject an XLA-executable stand-in
+    to exercise the wiring on CPU meshes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if draw_fn is None:
+        draw_fn = sample_fanout2
+    roots = jnp.asarray(roots, jnp.int32).reshape(-1)
+    seed = _two_word_seed(seed)
+
+    def body(adj1_l, adj2_l, roots_l, seed_l):
+        ai = jax.lax.axis_index(axis).astype(jnp.int32)
+        s = seed_l + (ai + 1) * jnp.int32(0x9E3779B1 - (1 << 32))
+        return draw_fn(adj1_l, adj2_l, roots_l, s, f1, f2)
+
+    sm = _shard_map()
+    out1, out2 = sm(
+        body,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(), adj1),
+            jax.tree.map(lambda _: P(), adj2),
+            P(axis),
+            P(),
+        ),
+        out_specs=(P(axis), P(axis)),
+        check_rep=False,
+    )(adj1, adj2, roots, seed)
+    return out1, out2
 
 
 def sample_neighbor_sharded(
@@ -376,23 +765,13 @@ def sample_neighbor_sharded(
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _sm  # jax >= 0.7 (check_vma kwarg)
-
-        def shard_map(f, **kw):
-            kw["check_vma"] = kw.pop("check_rep")
-            return _sm(f, **kw)
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
-
+    shard_map = _shard_map()
     if draw_fn is None:
         draw_fn = sample_neighbor
     nodes = jnp.asarray(nodes, jnp.int32)
     shape = nodes.shape
     flat = nodes.reshape(-1)
-    seed = jnp.atleast_1d(jnp.asarray(seed, jnp.int32))
-    if seed.shape[0] < 2:
-        seed = jnp.concatenate([seed, jnp.zeros(1, jnp.int32)])
+    seed = _two_word_seed(seed)
 
     def body(adj_l, nodes_l, seed_l):
         ai = jax.lax.axis_index(axis).astype(jnp.int32)

@@ -232,6 +232,40 @@ class Model:
         # and next() is atomic, where += would race and duplicate seeds
         self._sample_seed = itertools.count(1)
 
+    # device-sampling adjacency form, set via set_sampling_options:
+    # a max_degree slab cap for heavy-tailed graphs (truncation, the
+    # reference-semantics deviation PERF.md prices), or the exact O(E)
+    # alias form (no truncation; build_alias_adjacency)
+    sampling_max_degree: Optional[int] = None
+    sampling_alias: bool = False
+    # families whose device pipeline reads the 2-D slab itself (the
+    # full-neighborhood GCN path walks adj["nbr"][:, W]) set this False:
+    # the flat-CSR alias dict has no slab to walk
+    alias_sampling_ok: bool = True
+
+    def set_sampling_options(
+        self, max_degree: Optional[int] = None, alias: bool = False
+    ) -> None:
+        """Choose the device adjacency form BEFORE init_state/train:
+        ``max_degree`` caps the padded slab's width (heaviest neighbors
+        kept — changes hub distributions, see PERF.md's truncation
+        study); ``alias`` switches to the exact flat-CSR alias sampler
+        (no truncation, O(edges) memory) — the recommended form for
+        power-law graphs. Sorted (biased-walk) slabs ignore ``alias``:
+        the d_tx membership test needs id-sorted rows."""
+        if alias and max_degree is not None:
+            raise ValueError(
+                "alias sampling is exact: max_degree does not apply"
+            )
+        if alias and not self.alias_sampling_ok:
+            raise ValueError(
+                f"{type(self).__name__} walks the 2-D adjacency slab "
+                "(full-neighborhood aggregation) — alias sampling does "
+                "not apply; use max_degree to bound slab width instead"
+            )
+        self.sampling_max_degree = max_degree
+        self.sampling_alias = alias
+
     @staticmethod
     def adj_key(edge_types, sorted: bool = False) -> str:
         """consts['adj'] key for one edge-type set (shared so every model
@@ -258,11 +292,21 @@ class Model:
         ``max_degree`` caps the slab width on heavy-tailed graphs
         (heaviest neighbors kept, build_adjacency warns); ``sorted``
         builds id-sorted rows (under their own keys) for
-        device_graph.biased_random_walk."""
+        device_graph.biased_random_walk. ``max_degree`` defaults to the
+        model's set_sampling_options value; so does the slab-vs-alias
+        choice (alias = exact flat-CSR tables, never sorted)."""
         from euler_tpu.graph import device as device_graph
 
         from euler_tpu.graph import pallas_sampling
 
+        explicit_cap = max_degree is not None
+        if max_degree is None:
+            max_degree = self.sampling_max_degree
+        # an explicit per-call cap (e.g. GCN's pad-cap slabs) always
+        # means "this caller walks the slab" — never swap it for alias
+        use_alias = (
+            self.sampling_alias and not sorted and not explicit_cap
+        )
         # pack for the fused kernel on a single-device TPU (auto) or when
         # a kernel mesh is registered (per-shard shard_map path)
         use_pallas = pallas_sampling.available() or (
@@ -273,6 +317,11 @@ class Model:
         for et in edge_type_sets:
             k = self.adj_key(et, sorted=sorted)
             if k not in adj:
+                if use_alias:
+                    adj[k] = device_graph.build_alias_adjacency(
+                        graph, et, self.max_id
+                    )
+                    continue
                 adj[k] = device_graph.build_adjacency(
                     graph, et, self.max_id, max_degree=max_degree,
                     sorted=sorted,

@@ -112,6 +112,9 @@ class SupervisedGCN(base.Model):
     max_edges_per_hop are the static pad caps required for TPU shapes."""
 
     metric_name = "f1"
+    # full-neighborhood aggregation walks the 2-D slab (device.py
+    # multi_hop_neighbor) — the flat-CSR alias form has no slab to walk
+    alias_sampling_ok = False
 
     def __init__(
         self,
@@ -284,6 +287,9 @@ class ScalableGCN(base.ScalableStoreModel):
     from base.ScalableStoreModel."""
 
     metric_name = "f1"
+    # _expand_batch gathers full slab rows (adj["nbr"][roots] over W
+    # columns) — needs the 2-D slab form
+    alias_sampling_ok = False
 
     def __init__(
         self,

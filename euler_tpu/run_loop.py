@@ -143,6 +143,16 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
                    help="width of the 'model' mesh axis; >1 row-shards the "
                         "device-resident tables (consts, Scalable stores) "
                         "across it")
+    p.add_argument("--max_degree", type=int, default=None, help=(
+        "cap the device-sampling slab width (heaviest neighbors kept, "
+        "renormalized) — heavy-tail graphs only; changes hub "
+        "distributions, see PERF.md's truncation study"))
+    p.add_argument("--alias_sampling", type=_str2bool, default=False,
+                   help=(
+                       "device-sample through exact flat-CSR alias "
+                       "tables (O(edges) memory, no truncation) instead "
+                       "of padded slabs — the recommended form for "
+                       "power-law graphs like real Reddit"))
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
     p.add_argument("--profile_dir", default="")
@@ -663,6 +673,12 @@ def main(argv=None) -> int:
             "data",
         )
         model = build_model(args, graph)
+        if (args.max_degree is not None or args.alias_sampling) and hasattr(
+            model, "set_sampling_options"
+        ):
+            model.set_sampling_options(
+                max_degree=args.max_degree, alias=args.alias_sampling
+            )
         if args.mode == "train":
             run_train(model, graph, args, mesh)
         elif args.mode == "evaluate":

@@ -1,12 +1,18 @@
-"""Fused Pallas sampling kernel vs the host engine and the XLA path.
+"""Fused Pallas sampling kernels vs the host engine and the XLA path.
 
-These tests require a real single-device TPU backend (the kernel uses
-TPU-only primitives — on-core PRNG, row DMA — that neither the CPU
-backend nor pallas interpret mode supports), so the whole module skips
-under the CPU conftest. Run manually on a chip (the env var keeps
+The kernel-executing tests here require a real single-device TPU
+backend: they exercise the ON-CORE PRNG's stream (statistical pinning
+against the host engine) and the compiled kernels, which interpret
+mode cannot attest. Run manually on a chip (the env var keeps
 conftest.py from forcing the virtual CPU backend):
 
     EULER_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_pallas_sampling.py -v
+
+Everything BELOW the PRNG — layout, DMA addressing, rank/select across
+registers, the chained kernel's data-dependent hop-2 DMAs, default/OOB
+contracts — additionally runs on CPU in the default suite through
+pallas' TPU interpret mode with injected uniforms, as EXACT-equality
+tests: see tests/test_pallas_interpret.py.
 
 The recorded on-chip run for this round is in PERF.md (step anatomy
 section); the distribution check mirrors tests/test_device_graph.py's
@@ -37,6 +43,18 @@ def test_eligible_budgets():
     assert ps.eligible(1, ps.MAX_COUNT)
     assert not ps.eligible(1, ps.MAX_COUNT + 1)
     assert not ps.eligible(204800, 10)      # [M, count] past the VMEM cap
+
+
+def test_eligible2_budgets():
+    ps = pallas_sampling
+    assert ps.eligible2(512, 10, 10)            # the PPI recipe fanout
+    assert ps.eligible2(1000, 4, 4, k1=4, k2=4)  # reddit recipe, wide slabs
+    assert not ps.eligible2(512, ps.MAX_COUNT + 1, 4)
+    assert not ps.eligible2(204800, 10, 10)     # hop-2 out past VMEM cap
+    # hop-2 scratch at the MINIMUM stage (8 rows) must fit: k2*f1 <= 192,
+    # else the kernel would fail VMEM allocation instead of falling back
+    assert not ps.eligible2(128, 128, 2, k1=1, k2=4)
+    assert ps.eligible2(128, 48, 2, k1=1, k2=4)
 
 
 def test_pack_adjacency_hbm_budget():
@@ -575,3 +593,94 @@ def test_fanout_routes_through_kernel_and_trains(adj, graph):
         state, loss, _ = step(state, batch)
         losses.append(float(loss))
     assert np.mean(losses[-10:]) < np.mean(losses[:10])
+
+
+@tpu_only
+def test_chained_fanout_distribution_matches_host_engine(adj, graph):
+    """sample_fanout2 on the chip: hop-1 marginals match the host
+    engine's normalized weights, and hop-2 draws grouped by their
+    ACTUAL hop-1 source match that source's distribution — the
+    conditional check the chained kernel's data-dependent DMAs must
+    get right (reference: two chained CompactNode::SampleNeighbor
+    rounds, euler/core/compact_node.cc:42-101)."""
+    import jax.numpy as jnp
+
+    ids = np.arange(MAX_ID + 1)
+    nb, w, _, cnt = graph.get_full_neighbor(ids, [0, 1])
+    weights = {}
+    off = 0
+    for i, c in enumerate(cnt):
+        c = int(c)
+        nbrs, ws = nb[off:off + c], w[off:off + c]
+        off += c
+        if c and ws.sum() > 0:
+            weights[i] = dict(zip(nbrs, ws / ws.sum()))
+    f1, f2, calls = 16, 16, 24
+    f = jax.jit(
+        lambda r, s: pallas_sampling.sample_fanout2(
+            adj, adj, r, s, f1, f2
+        )
+    )
+    roots = jnp.asarray(ids, jnp.int32)
+    h1_all, pairs = [], []          # pairs: (hop-2 source id, drawn id)
+    for c in range(calls):
+        h1, h2 = f(roots, jnp.asarray([c, 5 * c + 1]))
+        h1, h2 = np.asarray(h1), np.asarray(h2)
+        h1_all.append(h1)
+        pairs.append(
+            np.stack(
+                [np.repeat(h1.reshape(-1), f2), h2.reshape(-1)], axis=1
+            )
+        )
+    h1_all = np.concatenate(h1_all, axis=1)     # [n_ids, calls*f1]
+    total1 = h1_all.shape[1]
+    for i in range(len(ids)):
+        if i not in weights:
+            assert (h1_all[i] == MAX_ID + 1).all()
+            continue
+        for n_, p in weights[i].items():
+            freq = (h1_all[i] == n_).mean()
+            assert abs(freq - p) < 6 * np.sqrt(p * (1 - p) / total1) + 1e-3
+    pairs = np.concatenate(pairs, axis=0)
+    for i, dist in weights.items():
+        drawn = pairs[pairs[:, 0] == i][:, 1]
+        if len(drawn) < 512:        # too few hop-1 visits to pin
+            continue
+        for n_, p in dist.items():
+            freq = (drawn == n_).mean()
+            assert abs(freq - p) < 6 * np.sqrt(p * (1 - p) / len(drawn)) + 2e-3
+    # every hop-2 row whose source is the default node stays default
+    dflt = pairs[pairs[:, 0] == MAX_ID + 1][:, 1]
+    assert len(dflt) and (dflt == MAX_ID + 1).all()
+
+
+@tpu_only
+def test_chained_sharded_kernel_executes_on_hardware(adj, graph):
+    """The chained kernel inside shard_map on the chip (1-device mesh,
+    like test_sharded_kernel_executes_on_hardware): shapes, in-graph
+    picks, and hop-1 marginals for one well-connected node."""
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    roots = jnp.full((32,), 10, jnp.int32)
+    f = jax.jit(
+        lambda r, s: pallas_sampling.sample_fanout2_sharded(
+            adj, adj, r, s, 8, 4, mesh, "data"
+        )
+    )
+    h1, h2 = f(roots, jnp.asarray([3, 11]))
+    assert h1.shape == (32, 8) and h2.shape == (256, 4)
+    assert (np.asarray(h1) <= MAX_ID + 1).all()
+    assert (np.asarray(h2) <= MAX_ID + 1).all()
+    nb, w, _, cnt = graph.get_full_neighbor(np.array([10]), [0, 1])
+    expect = dict(zip(nb[: int(cnt[0])], w[: int(cnt[0])]))
+    total = sum(expect.values())
+    draws = np.concatenate(
+        [np.asarray(f(roots, jnp.asarray([c, c]))[0]).reshape(-1)
+         for c in range(8)]
+    )
+    for n_, ww in expect.items():
+        p = ww / total
+        freq = (draws == n_).mean()
+        assert abs(freq - p) < 6 * np.sqrt(p * (1 - p) / len(draws)) + 1e-3
