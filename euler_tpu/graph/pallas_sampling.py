@@ -489,15 +489,17 @@ def _fanout2_kernel(ids_ref, seed_ref, pk1_hbm, pk2_hbm, *rest,
     the dependent DMA latency hides behind compute instead of
     serializing after it."""
     import jax
+    import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if with_u:
-        u1_ref, u2_ref, out1_ref, out2_ref, pk1_s, pk2_s, picks_s, \
-            sem1, sem2, semp = rest
+        u1_ref, u2_ref, out1_ref, out2_ref, pk1_s, pk2_s, picks_v, \
+            picks_s, sem1, sem2, semp = rest
     else:
         u1_ref = u2_ref = None
-        out1_ref, out2_ref, pk1_s, pk2_s, picks_s, sem1, sem2, semp = rest
+        (out1_ref, out2_ref, pk1_s, pk2_s, picks_v, picks_s, sem1, sem2,
+         semp) = rest
 
     pltpu.prng_seed(seed_ref[0], seed_ref[1])
     rows2 = rows * f1
@@ -571,9 +573,15 @@ def _fanout2_kernel(ids_ref, seed_ref, pk1_hbm, pk2_hbm, *rest,
         wait1(slot, it)
         picks = _stage_draw(pk1_s[slot], rows, k1, f1, next_u1(it))
         out1_ref[pl.ds(it * rows, rows), :] = picks
-        cp = pltpu.make_async_copy(
-            out1_ref.at[pl.ds(it * rows, rows), :], picks_s, semp
-        )
+        # VMEM->SMEM so the picks can address HBM. Mosaic requires DMA
+        # slices lane-aligned to the (·, 128) tiling, so the copy source
+        # is a full-width scratch (picks lane-padded with zeros), not an
+        # f1-wide slice of out1 — hardware rejects the narrow slice
+        # (interpret mode does not model the tiling constraint).
+        picks_v[:, :] = jnp.concatenate(
+            [picks, jnp.zeros((rows, LANES - f1), jnp.int32)], axis=1
+        ) if f1 < LANES else picks
+        cp = pltpu.make_async_copy(picks_v, picks_s, semp)
         cp.start()
         cp.wait()
         issue2(slot)
@@ -637,11 +645,13 @@ def sample_fanout2(adj1: dict, adj2: dict, roots, seed, f1: int, f2: int,
     )
     # stage size: power-of-two (sublane-aligned out1 slices), sized so
     # the hop-2 scratch (2 slots x 2*k2*R*f1 rows) stays ~<= 3 MB and
-    # the SMEM pick buffer (R x f1 ids) stays ~<= 8 KB
+    # the full-lane-width pick buffers (R x 128 ids in VMEM scratch and
+    # SMEM — full width because the VMEM->SMEM DMA must be 128-lane
+    # aligned) stay <= 8 KB, i.e. R <= 16
     r_max = min(
         _MAX_R // k1,
         max(1, 1536 // (k2 * f1)),
-        max(1, 2048 // f1),
+        16,
     )
     r_max = max(8, 1 << (r_max.bit_length() - 1))
     rows = r_max if m >= r_max else max(8, 1 << (m - 1).bit_length())
@@ -680,7 +690,8 @@ def sample_fanout2(adj1: dict, adj2: dict, roots, seed, f1: int, f2: int,
         scratch_shapes=[
             pltpu.VMEM((2, 2 * k1 * rows, LANES), jnp.int32),
             pltpu.VMEM((2, 2 * k2 * rows * f1, LANES), jnp.int32),
-            pltpu.SMEM((rows, f1), jnp.int32),
+            pltpu.VMEM((rows, LANES), jnp.int32),
+            pltpu.SMEM((rows, LANES), jnp.int32),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
