@@ -6,6 +6,7 @@ from euler_tpu.parallel.mesh import (
     probe_backend_or_die,
     make_mesh,
     pad_tables_for_mesh,
+    put_global,
     replicated_sharding,
     shard_batch,
     state_sharding,
@@ -21,6 +22,7 @@ __all__ = [
     "probe_backend_or_die",
     "make_mesh",
     "pad_tables_for_mesh",
+    "put_global",
     "replicated_sharding",
     "shard_batch",
     "state_sharding",

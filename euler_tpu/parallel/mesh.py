@@ -241,11 +241,53 @@ def pad_tables_for_mesh(state, mesh: Mesh):
     return jax.tree_util.tree_map_with_path(pad, state)
 
 
+def put_global(tree, shardings):
+    """device_put a host pytree onto its shardings, multi-process aware.
+
+    Single-controller: plain jax.device_put. Under jax.distributed
+    (process_count > 1) the shardings span devices this process cannot
+    address, so each leaf becomes a global jax.Array assembled from the
+    process-local shards instead — every process must hold the SAME full
+    host value (true for replicated params initialised from one PRNG
+    seed and for consts derived from the same graph). This is the
+    multi-host analog of the reference's parameter-server variable
+    placement (reference tf_euler/python/run_loop.py:391-394)."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def put(x, s):
+        if isinstance(x, jax.Array) and x.sharding == s:
+            # already placed (e.g. a checkpoint-restored global array) —
+            # np.asarray on it would crash for model-axis-sharded leaves
+            # (spans non-addressable devices) and needlessly round-trip
+            # everything else
+            return x
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, s, lambda idx: x[idx])
+
+    return jax.tree.map(put, tree, shardings)
+
+
 def shard_batch(batch, mesh: Mesh):
     """Place a host batch pytree onto the mesh, leading dim sharded
-    (scalars — e.g. a device-sampling seed — are replicated)."""
+    (scalars — e.g. a device-sampling seed — are replicated).
+
+    Multi-process (jax.distributed): ``batch`` is this process's LOCAL
+    shard — leading dims concatenate across processes in process order,
+    so the global batch is num_processes x the local size. Scalars must
+    be identical on every process (they replicate)."""
     sharding = batch_sharding(mesh)
     rep = replicated_sharding(mesh)
+    if jax.process_count() > 1:
+        def put(x):
+            x = np.asarray(x)
+            if np.ndim(x) == 0:
+                return jax.make_array_from_callback(
+                    x.shape, rep, lambda idx: x[idx]
+                )
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree.map(put, batch)
     return jax.tree.map(
         lambda x: jax.device_put(
             x, rep if np.ndim(x) == 0 else sharding

@@ -534,7 +534,20 @@ def _num_steps(args) -> int:
 
 
 def run_train(model, graph, args, mesh):
+    import jax
+
     batch = args.batch_size * getattr(model, "batch_size_ratio", 1)
+    # jax.distributed data parallelism: --batch_size stays the GLOBAL
+    # batch (flag parity with the reference's per-cluster semantics);
+    # each process samples its share and the shards concatenate onto the
+    # global mesh in train_lib (shard_batch).
+    n_proc = jax.process_count()
+    if batch % n_proc:
+        raise ValueError(
+            f"--batch_size*ratio {batch} not divisible by "
+            f"{n_proc} processes"
+        )
+    batch //= n_proc
 
     def source_fn(step):
         return np.asarray(graph.sample_node(batch, args.train_node_type))
@@ -607,7 +620,22 @@ def run_evaluate(model, graph, args, mesh):
         for i in range(0, len(padded), batch):
             yield padded[i : i + batch]
 
-    return train_lib.evaluate(model, graph, batches(), state, mesh=mesh)
+    result = train_lib.evaluate(model, graph, batches(), state, mesh=mesh)
+    import jax
+
+    if args.model_dir and jax.process_index() == 0:
+        # persist the metrics next to the checkpoint so callers (dress
+        # rehearsals, sweep scripts) can gate on them instead of
+        # scraping logs
+        import json
+
+        os.makedirs(args.model_dir, exist_ok=True)
+        with open(os.path.join(args.model_dir, "eval.json"), "w") as f:
+            json.dump(
+                {**result, "id_file": args.id_file, "model": args.model},
+                f,
+            )
+    return result
 
 
 def run_save_embedding(model, graph, args, mesh):

@@ -23,6 +23,7 @@ from euler_tpu.parallel import (
     make_mesh,
     pad_tables_for_mesh,
     prefetch,
+    put_global,
     replicated_sharding,
     shard_batch,
     state_sharding,
@@ -92,6 +93,16 @@ def train(
     source_fn(step) -> int64 root-node batch (fixed size, divisible by the
     mesh size). All sampling runs in the prefetch workers.
 
+    Multi-process (jax.distributed initialized, process_count > 1):
+    source_fn yields this process's LOCAL batch (global batch /
+    process_count roots); each process samples its own subgraphs and the
+    batches concatenate across processes onto the global mesh
+    (shard_batch), with XLA all-reducing gradients across process
+    boundaries inside the jitted step. State is initialised identically
+    everywhere (same seed) and placed via put_global. checkpoint_dir
+    must then be a path every process can reach (orbax coordinates the
+    distributed save) or None.
+
     device_prefetch=True also issues the host->device copy from the
     prefetch workers, overlapping H2D of batch k+1 with compute of step k
     — at the cost of holding up to prefetch_depth+1 staged batches in
@@ -132,7 +143,7 @@ def train(
     # 'model' axis when present (pure DP: everything replicated).
     state = pad_tables_for_mesh(state, mesh)
     shardings = state_sharding(mesh, state)
-    state = jax.device_put(state, shardings)
+    state = put_global(state, shardings)
 
     ckpt = None
     start_step = 0
@@ -143,7 +154,7 @@ def train(
         latest = ckpt.latest_step()
         if latest is not None:
             state = ckpt.restore(state, latest)
-            state = jax.device_put(state, shardings)
+            state = put_global(state, shardings)
             start_step = latest
             (log_fn or log.info)(
                 f"resumed from {checkpoint_dir} at step {latest}"
@@ -204,9 +215,14 @@ def train(
         # Deterministic per-worker sampler streams: the native RNG is
         # thread-local, so each prefetch worker gets its own seeded stream
         # derived from the run seed (reference samplers are unseeded).
+        # Multi-process data parallelism folds the process index in —
+        # with identical streams every process would draw the SAME local
+        # roots, silently collapsing the global batch to one process's.
         from euler_tpu.graph.native import lib
 
-        lib().eg_seed(seed * 1_000_003 + widx + 1)
+        lib().eg_seed(
+            seed * 1_000_003 + jax.process_index() * 8_191 + widx + 1
+        )
 
     profiling = False
     for batch in prefetch(
@@ -302,13 +318,19 @@ def evaluate(
     log_fn=None,
 ):
     """Streaming evaluation over an iterator of root-node batches
-    (reference run_loop.py:143-171)."""
+    (reference run_loop.py:143-171).
+
+    Multi-process: every process must iterate the SAME global batches
+    (collectives run in lockstep); each samples only its contiguous
+    1/process_count slice and shard_batch concatenates — the jitted
+    metric is computed over the reassembled global batch, so the result
+    is identical to single-process."""
     if mesh is None:
         mesh = make_mesh()
     rep = replicated_sharding(mesh)
     state = pad_tables_for_mesh(state, mesh)
     shardings = state_sharding(mesh, state)
-    state = jax.device_put(state, shardings)
+    state = put_global(state, shardings)
     eval_fn = jax.jit(
         model.make_eval_step(),
         in_shardings=(shardings, batch_sharding(mesh)),
@@ -317,7 +339,17 @@ def evaluate(
     name = model.metric_name
     acc = _metric_zero(name)
     losses = []
+    n_proc = jax.process_count()
     for ids in source_iter:
+        if n_proc > 1:
+            ids = np.asarray(ids)
+            if len(ids) % n_proc:
+                raise ValueError(
+                    f"eval batch {len(ids)} not divisible by "
+                    f"{n_proc} processes"
+                )
+            per = len(ids) // n_proc
+            ids = ids[jax.process_index() * per:][:per]
         batch = shard_batch(model.sample(graph, ids), mesh)
         loss, metric = eval_fn(state, batch)
         acc = _metric_accumulate(name, acc, metric)
@@ -336,23 +368,39 @@ def save_embedding(
     mesh=None,
 ):
     """Export embeddings for ids 0..max_id as a [max_id+1, dim] array
-    (reference run_loop.py:174-219 exports .npy + id file)."""
+    (reference run_loop.py:174-219 exports .npy + id file).
+
+    Multi-process: each process samples its contiguous slice of every
+    chunk; the output sharding is replicated there (XLA all-gathers over
+    ICI) so every process returns the full matrix — a batch-sharded
+    output would span non-addressable devices and be unfetchable."""
     if mesh is None:
         mesh = make_mesh()
     state = pad_tables_for_mesh(state, mesh)
     shardings = state_sharding(mesh, state)
-    state = jax.device_put(state, shardings)
+    state = put_global(state, shardings)
+    n_proc = jax.process_count()
+    if batch_size % (n_proc or 1):
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by {n_proc} processes"
+        )
     embed_fn = jax.jit(
         model.make_embed_step(),
         in_shardings=(shardings, batch_sharding(mesh)),
-        out_shardings=batch_sharding(mesh),
+        out_shardings=(
+            replicated_sharding(mesh) if n_proc > 1
+            else batch_sharding(mesh)
+        ),
     )
     chunks = []
     ids = np.arange(max_id + 1, dtype=np.int64)
     pad = (-len(ids)) % batch_size
     padded = np.concatenate([ids, np.zeros(pad, dtype=np.int64)])
+    per = batch_size // n_proc
     for i in range(0, len(padded), batch_size):
         chunk = padded[i : i + batch_size]
+        if n_proc > 1:
+            chunk = chunk[jax.process_index() * per:][:per]
         batch = shard_batch(model.sample_embed(graph, chunk), mesh)
         chunks.append(np.asarray(embed_fn(state, batch)))
     out = np.concatenate(chunks, axis=0)[: len(ids)]

@@ -1,0 +1,141 @@
+"""Multi-process data-parallel training through the REAL CLI.
+
+VERDICT r3 next-#5: the full-stack distributed test (bespoke worker
+script) proved the planes compose; this one proves the *shipped driver*
+does — two OS processes each run ``python -m euler_tpu`` with the
+jax.distributed flags (--coordinator_addr/--num_processes/--process_id,
+the reference's PS/worker ClusterSpec analog, reference
+tf_euler/python/run_loop.py:371-397 + scripts/dist_tf_euler.sh), in
+--graph_mode=shared: each process serves its own graph shard
+(reference initialize_shared_graph, tf_euler base.py:64), discovers the
+other over the TCP registry, trains SupervisedGraphSage data-parallel
+over one global 4-device mesh (XLA all-reduces gradients across the
+process boundary), and must reach the SAME planted-community
+convergence gate as a single-process run of the identical recipe —
+loss/F1 parity in the statistical sense the random samplers allow.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+STEP_RE = re.compile(
+    r"step=(\d+) loss=([\d.eE+-]+) f1=([\d.eE+-]+)"
+)
+
+# one recipe, both topologies: 300 steps of batch-128 GraphSAGE on the
+# 2000-node planted-community graph (mirrors test_convergence's gate)
+RECIPE = [
+    "--mode", "train",
+    "--model", "graphsage_supervised",
+    "--max_id", "1999",
+    "--label_idx", "0", "--label_dim", "4",
+    "--feature_idx", "1", "--feature_dim", "16",
+    "--sigmoid_loss", "false",
+    "--fanouts", "10,10", "--dim", "32", "--aggregator", "mean",
+    "--batch_size", "128", "--num_epochs", "20",  # 15 steps/epoch -> 300
+    "--learning_rate", "0.01", "--log_steps", "100",
+    "--all_edge_type", "0", "--train_edge_type", "0",
+    "--train_node_type", "-1",
+]
+
+
+@pytest.fixture(scope="module")
+def planted_dir(tmp_path_factory):
+    from euler_tpu.datasets import build_planted, nearest_centroid_accuracy
+
+    d = tmp_path_factory.mktemp("planted_cli")
+    out_dir, info = build_planted(str(d))
+    feat_acc = nearest_centroid_accuracy(info, use_neighbors=False)
+    hop1_acc = nearest_centroid_accuracy(info, use_neighbors=True)
+    return out_dir, feat_acc, hop1_acc
+
+
+def _run_cli(args, timeout=420):
+    """One ``python -m euler_tpu`` process on 2 virtual CPU devices.
+    Returns the Popen (caller communicates)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    return subprocess.Popen(
+        [sys.executable, "-m", "euler_tpu", *map(str, args)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env,
+    )
+
+
+def _final_window(err: str):
+    """(loss, f1) of the last logged training window."""
+    matches = STEP_RE.findall(err)
+    assert matches, f"no train-step log lines in:\n{err[-2000:]}"
+    step, loss, f1 = matches[-1]
+    return float(loss), float(f1)
+
+
+def test_run_loop_two_process_matches_single(planted_dir, tmp_path):
+    from tests.conftest import free_port
+
+    out_dir, feat_acc, hop1_acc = planted_dir
+
+    # single-process baseline, same global batch, local graph.
+    # --model_dir= (empty) disables checkpointing: the default ("ckpt",
+    # CWD-relative) would resume from whatever an earlier run left
+    # there, and multihost orbax coordination is not this test's
+    # subject.
+    p = _run_cli(["--data_dir", out_dir, "--graph_mode", "local",
+                  "--model_dir", "", *RECIPE])
+    out, err = p.communicate(timeout=420)
+    assert p.returncode == 0, f"single-process run failed:\n{err[-2500:]}"
+    loss1, f1_1 = _final_window(err)
+
+    # two processes through the shipped flags: TCP registry hosted by
+    # process 0, per-process graph shards, jax.distributed collectives
+    coord = f"127.0.0.1:{free_port()}"
+    reg = f"tcp://127.0.0.1:{free_port()}"
+    procs = [
+        _run_cli([
+            "--data_dir", out_dir, "--graph_mode", "shared",
+            "--registry", reg,
+            "--coordinator_addr", coord,
+            "--num_processes", "2", "--process_id", pid,
+            "--model_dir", "",
+            *RECIPE,
+        ])
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for pid, p in enumerate(procs):
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, (
+                f"worker {pid} failed:\n{err[-2500:]}"
+            )
+            outs.append((out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    finals = [_final_window(err) for _, err in outs]
+    # replicated training state: both processes log identical numbers
+    assert np.isclose(finals[0][0], finals[1][0], rtol=1e-4), finals
+    assert np.isclose(finals[0][1], finals[1][1], rtol=1e-4), finals
+    loss2, f1_2 = finals[0]
+
+    # both topologies must clear the planted-community learning gate ...
+    for label, f1 in (("1-process", f1_1), ("2-process", f1_2)):
+        assert f1 > feat_acc + 0.2, (
+            f"{label} final-window f1 {f1:.3f} vs single-node feature "
+            f"bound {feat_acc:.3f}: aggregation is not learning"
+        )
+    # ... and agree with each other (independent sampler streams leave
+    # statistical wiggle; converged windows agree much tighter than this)
+    assert abs(f1_1 - f1_2) < 0.08, (f1_1, f1_2)
+    assert abs(loss1 - loss2) < 0.25, (loss1, loss2)
