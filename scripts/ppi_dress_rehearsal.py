@@ -77,11 +77,16 @@ def write_replica(prefix: str, num_nodes: int, num_links: int,
     with open(prefix + "-class_map.json", "w") as f:
         json.dump({str(i): labels[i].tolist() for i in range(num_nodes)}, f)
     kept = ~np.isin(np.arange(num_nodes), list(drop))
+    # trivial-predictor micro-F1 on the val labels — predicting every
+    # label positive scores 2p/(1+p); a model that learned the linear
+    # label function must clear it by a margin (the test gate)
+    p = float(labels[kept & is_val].mean())
     return {
         "train": int((kept & ~is_val & ~is_test).sum()),
         "val": int((kept & is_val).sum()),
         "test": int((kept & is_test).sum()),
         "links": len(links),
+        "allpos_f1": round(2 * p / (1 + p), 4),
     }
 
 
@@ -127,6 +132,10 @@ def run(num_nodes: int, num_links: int, epochs: int, batch_size: int,
             )
             summary["evaluate_s"] = round(time.time() - t3, 1)
             summary["evaluate_rc"] = rc
+            eval_json = os.path.join(model_dir, "eval.json")
+            if rc == 0 and os.path.exists(eval_json):
+                with open(eval_json) as f:
+                    summary["val_metrics"] = json.load(f)
         return summary
     finally:
         if own_dir:

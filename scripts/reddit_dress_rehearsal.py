@@ -76,11 +76,18 @@ def write_replica(data_dir: str, num_nodes: int, avg_degree: int,
         shape=(num_nodes, num_nodes),
     )
     sp.save_npz(os.path.join(data_dir, "reddit_self_loop_graph.npz"), adj)
+    # majority-class accuracy on the val split: the strongest
+    # label-marginal-only predictor; the learned model must clear it by
+    # a margin (the test gate)
+    val_labels = labels[node_types == 2]
+    counts = np.bincount(val_labels, minlength=num_classes)
     return {
         "train": int((node_types == 1).sum()),
         "val": int((node_types == 2).sum()),
         "test": int((node_types == 3).sum()),
         "edges": int(len(indices)),
+        "majority_acc": round(float(counts.max() / max(len(val_labels), 1)),
+                              4),
     }
 
 
@@ -126,6 +133,10 @@ def run(num_nodes: int, avg_degree: int, epochs: int, batch_size: int,
             )
             summary["evaluate_s"] = round(time.time() - t3, 1)
             summary["evaluate_rc"] = rc
+            eval_json = os.path.join(model_dir, "eval.json")
+            if rc == 0 and os.path.exists(eval_json):
+                with open(eval_json) as f:
+                    summary["val_metrics"] = json.load(f)
         return summary
     finally:
         if own_dir:

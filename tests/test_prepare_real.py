@@ -166,14 +166,28 @@ def test_ppi_dress_rehearsal_at_scale(tmp_path):
     ))
     import ppi_dress_rehearsal as rehearsal
 
+    # the real recipe's batch/dim (reference examples/sage.py:80-98):
+    # at dim 32 the 121 independent label functions can't be represented
+    # and val F1 plateaus a hair above the trivial baseline; at the
+    # recipe's dim 256 the gate clears by ~0.18 in 25 steps
     summary = rehearsal.run(
-        num_nodes=3000, num_links=40000, epochs=1, batch_size=128,
-        dim=32, workdir=str(tmp_path),
+        num_nodes=3000, num_links=40000, epochs=5, batch_size=512,
+        dim=256, workdir=str(tmp_path),
     )
     assert summary["train_rc"] == 0
     assert summary["evaluate_rc"] == 0
     s = summary["splits"]
     assert s["train"] > s["val"] > 0 and s["test"] > 0
+    # learning gate (VERDICT r3 next-#6): replica labels are a linear
+    # function of the features, so the trained model's val micro-F1 must
+    # clear the best label-marginal-only predictor (all-positive,
+    # 2p/(1+p) — computed from the written labels, not folklore) by a
+    # real margin. The recorded full-size run reached 0.919.
+    val_f1 = summary["val_metrics"]["f1"]
+    assert val_f1 > s["allpos_f1"] + 0.1, (
+        f"val micro-F1 {val_f1:.3f} vs all-positive baseline "
+        f"{s['allpos_f1']:.3f}: prepare->train->evaluate is not learning"
+    )
 
 
 @pytest.mark.slow
@@ -189,11 +203,26 @@ def test_reddit_dress_rehearsal_at_scale(tmp_path):
     ))
     import reddit_dress_rehearsal as rehearsal
 
+    # 20k nodes: below ~15k the ~66%-train split cannot identify the
+    # 602-dim x 41-class label map (a dim-64 net interpolates the train
+    # nodes without generalizing — val stays at chance while train F1
+    # climbs); at 20k / 3 epochs val clears majority-chance ~5x
     summary = rehearsal.run(
-        num_nodes=5000, avg_degree=10, epochs=1, batch_size=200,
+        num_nodes=20000, avg_degree=10, epochs=3, batch_size=200,
         workdir=str(tmp_path),
     )
     assert summary["train_rc"] == 0
     assert summary["evaluate_rc"] == 0
     s = summary["splits"]
     assert s["train"] > s["test"] > s["val"] > 0
+    # learning gate (VERDICT r3 next-#6): 41-class labels are argmax of
+    # a linear map of the features; the val metric must clear the
+    # majority-class baseline (computed from the written labels) by a
+    # real margin. The recorded full-size run reached 0.409 vs 0.024
+    # chance after one epoch.
+    val_metric = summary["val_metrics"]["f1"]
+    assert val_metric > s["majority_acc"] + 0.1, (
+        f"val metric {val_metric:.3f} vs majority-class baseline "
+        f"{s['majority_acc']:.3f}: prepare->train->evaluate is not "
+        "learning"
+    )
