@@ -74,7 +74,6 @@ def full_scale(workdir: str, num_edges: int, batch: int, steps: int) -> dict:
     g = euler_tpu.Graph(directory=workdir)
     out["engine_load_s"] = round(time.time() - t1, 1)
     out["engine_rss_mb"] = round(rss_mb() - rss0, 1)
-    out["num_edges_achieved"] = int(g.num_edges())
 
     n = cfg["num_nodes"]
     counts = np.zeros(n, np.int64)
@@ -82,6 +81,9 @@ def full_scale(workdir: str, num_edges: int, batch: int, steps: int) -> dict:
         ids = np.arange(lo, min(lo + 65536, n))
         _, _, _, c = g.get_full_neighbor(ids, [0])
         counts[lo:lo + len(ids)] = c
+    # Graph.num_edges counts edge-feature OBJECTS (this generator writes
+    # none); the achieved adjacency size is the degree sum
+    out["num_edges_achieved"] = int(counts.sum())
     out["degree"] = {
         "mean": round(float(counts.mean()), 1),
         "p99": int(np.percentile(counts, 99)),
@@ -229,9 +231,11 @@ def main() -> None:
     ap.add_argument("--full", action="store_true")
     ap.add_argument("--truncation-study", action="store_true")
     ap.add_argument("--workdir", default=None)
-    ap.add_argument("--num-edges", type=int, default=120_000_000,
-                    help="draw target; dict-dedup trims ~2-5%% so the "
-                    "achieved count lands near the real 114.6M")
+    ap.add_argument("--num-edges", type=int, default=114_600_000,
+                    help="edge target; the unique-fill generator lands "
+                    "a few %% under this (hub rows can exhaust the "
+                    "bounded redraw rounds; measured 4.5%% under at "
+                    "the Reddit recipe)")
     ap.add_argument("--batch", type=int, default=1000)
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--study-steps", type=int, default=400)

@@ -1,13 +1,33 @@
 #!/bin/sh
 # Everything that needs the real chip, in dependency order:
 #  1. the TPU-gated Pallas kernel suite (distribution pinning vs the host
-#     engine, OOB clamp, wide-slab register-boundary draw)
+#     engine, OOB clamp, wide-slab register-boundary draw, the chained
+#     two-hop kernel, both shard_map SPMD paths) plus the alias-sampler
+#     suite on the real backend
 #  2. the headline benchmark (device-sampling scan loop, kernel on/off
 #     A/B on the ppi config, prefetch-overlap breakdown, profiler trace)
 # CPU-only environments: the kernel suite skips itself; bench falls back
-# with an "error" field. Safe to run unattended (probe subprocesses are
-# killable; the bench has a hang watchdog).
-set -e
-cd "$(dirname "$0")/.."
-EULER_TPU_TESTS_ON_TPU=1 python -m pytest tests/test_pallas_sampling.py -v
-python bench.py
+# with an "error" field. Safe to run unattended: every step has a hard
+# deadline and unbuffered output — the relay has been observed to wedge
+# AFTER a successful probe (2026-07-31: pytest blocked 19 min in backend
+# init with zero CPU accumulation), and a silent hang must surface as a
+# visible timeout, not eat the session. Exit code 124 from a step means
+# the deadline hit (relay wedged mid-run).
+cd "$(dirname "$0")/.." || exit 1
+SUITE_DEADLINE=${EULER_TPU_SUITE_DEADLINE:-1200}
+
+EULER_TPU_TESTS_ON_TPU=1 timeout -k 30 "$SUITE_DEADLINE" \
+  python -u -m pytest tests/test_pallas_sampling.py \
+  tests/test_alias_sampling.py -v
+suite_rc=$?
+# 124 = SIGTERM honored; 137 = the wedged-in-device-wait mode ignores
+# SIGTERM and eats the -k 30 SIGKILL instead — both are the deadline
+if [ "$suite_rc" -eq 124 ] || [ "$suite_rc" -eq 137 ]; then
+  echo "tpu_checks: SUITE DEADLINE (${SUITE_DEADLINE}s) hit — relay wedged mid-run" >&2
+fi
+[ "$suite_rc" -eq 0 ] || exit "$suite_rc"
+
+# bench.py carries its own probe subprocesses + in-process watchdog
+# (EULER_TPU_BENCH_DEADLINE, default 2400 s); -u so partial JSON lines
+# land even if the watchdog hard-exits
+python -u bench.py
