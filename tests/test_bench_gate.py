@@ -123,3 +123,23 @@ def test_probe_or_die_fails_fast_and_reprobes(monkeypatch):
     monkeypatch.setattr(mesh, "_PROBE_SRC", "import time; time.sleep(60)")
     mesh.probe_backend_or_die(timeout_s=0.5)  # skipped: CPU-pinned
     assert not mesh._probed_ok
+
+
+def test_heavytail_config_has_no_shape_literals(bench):
+    """The reddit_heavytail graph shape comes from
+    datasets.REDDIT_HEAVYTAIL at run time (run_config merges it in); a
+    shape literal re-appearing in CONFIGS would shadow the authoritative
+    constant, silently invalidate the shared ~2 GB cache, and measure a
+    different graph than PERF.md describes."""
+    from euler_tpu.datasets import REDDIT_HEAVYTAIL
+
+    cfg = bench.CONFIGS["reddit_heavytail"]
+    assert cfg.get("powerlaw") and cfg.get("alias_sampling")
+    overlap = set(cfg) & set(REDDIT_HEAVYTAIL)
+    assert not overlap, f"shape keys must live in datasets only: {overlap}"
+    # and the merge supplies everything run_config's build needs
+    merged = {**cfg, **REDDIT_HEAVYTAIL}
+    for key in ("num_nodes", "num_edges", "feature_dim", "label_dim",
+                "alpha", "multilabel", "batch", "fanouts", "dim", "lr",
+                "warmup", "measure"):
+        assert key in merged, key
