@@ -294,6 +294,47 @@ def powerlaw_degrees(
     return np.maximum(d.round(), d_min).astype(np.int64)
 
 
+def _powerlaw_params(num_nodes, num_edges, feature_dim, label_dim,
+                     alpha, multilabel, num_partitions, seed) -> str:
+    """The cache-identity string build_powerlaw's done marker records —
+    one constructor so external gates (scripts/tpu_checks.sh's
+    heavytail step) and the builder can never disagree on it."""
+    return json.dumps(
+        dict(kind="powerlaw", num_nodes=num_nodes, num_edges=num_edges,
+             feature_dim=feature_dim, label_dim=label_dim, alpha=alpha,
+             multilabel=multilabel, num_partitions=num_partitions,
+             seed=seed, gen="unique-fill-v3-gumbel-hubs"),
+        sort_keys=True,
+    )
+
+
+def powerlaw_cache_ready(
+    out_dir: str,
+    num_nodes: int,
+    num_edges: int,
+    feature_dim: int,
+    label_dim: int,
+    alpha: float = 1.8,
+    multilabel: bool = False,
+    num_partitions: int = 4,
+    seed: int = 17,
+) -> bool:
+    """True when ``out_dir`` holds a FINISHED build_powerlaw cache with
+    EXACTLY these params (the done marker records them). A bare
+    existence check is not enough: _cache_begin wipes and regenerates
+    on any params mismatch, so a gate that only tests the marker file
+    would wave through a stale cache and pay the full rebuild anyway —
+    on a chip window, if the caller is scripts/tpu_checks.sh."""
+    marker = os.path.join(out_dir, "done")
+    if not os.path.exists(marker):
+        return False
+    with open(marker) as f:
+        return f.read() == _powerlaw_params(
+            num_nodes, num_edges, feature_dim, label_dim, alpha,
+            multilabel, num_partitions, seed,
+        )
+
+
 def build_powerlaw(
     out_dir: str,
     num_nodes: int,
@@ -329,12 +370,9 @@ def build_powerlaw(
     out_dir.
     """
     os.makedirs(out_dir, exist_ok=True)
-    params = json.dumps(
-        dict(kind="powerlaw", num_nodes=num_nodes, num_edges=num_edges,
-             feature_dim=feature_dim, label_dim=label_dim, alpha=alpha,
-             multilabel=multilabel, num_partitions=num_partitions,
-             seed=seed, gen="unique-fill-v3-gumbel-hubs"),
-        sort_keys=True,
+    params = _powerlaw_params(
+        num_nodes, num_edges, feature_dim, label_dim, alpha, multilabel,
+        num_partitions, seed,
     )
     if _cache_begin(out_dir, params):
         return out_dir
