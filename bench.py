@@ -263,15 +263,9 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
     batch_size, fanouts, dim = cfg["batch"], list(cfg["fanouts"]), cfg["dim"]
 
     if cfg.get("powerlaw"):
-        from euler_tpu.datasets import build_powerlaw
+        from euler_tpu.datasets import build_powerlaw, heavytail_cache_dir
 
-        cache = os.environ.get(
-            "EULER_TPU_HEAVYTAIL_CACHE",
-            os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                ".data", "reddit_ht",
-            ),
-        )
+        cache = heavytail_cache_dir()
         build_powerlaw(
             cache,
             num_nodes=cfg["num_nodes"],

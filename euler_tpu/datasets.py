@@ -308,6 +308,22 @@ def _powerlaw_params(num_nodes, num_edges, feature_dim, label_dim,
     )
 
 
+def heavytail_cache_dir() -> str:
+    """Default build_powerlaw cache dir for the Reddit-scale graph —
+    ONE resolver shared by bench.py's reddit_heavytail config,
+    scripts/reddit_heavytail.py --full, and scripts/tpu_checks.sh's
+    gate (a third hard-coded copy of the path is how the gate ends up
+    checking a different directory than the bench builds in).
+    EULER_TPU_HEAVYTAIL_CACHE overrides; else <repo>/.data/reddit_ht."""
+    return os.environ.get(
+        "EULER_TPU_HEAVYTAIL_CACHE",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".data", "reddit_ht",
+        ),
+    )
+
+
 def powerlaw_cache_ready(
     out_dir: str,
     num_nodes: int,

@@ -164,6 +164,140 @@ def full_scale(workdir: str, num_edges: int, batch: int, steps: int) -> dict:
     return out
 
 
+def walk_study(
+    pairs_per_cap: int = 400,
+    seed: int = 11,
+    caps=(64, 256, 512),
+    num_nodes: int = 6000,
+    num_edges: int = 600_000,
+) -> dict:
+    """Quantify the biased-walk truncation distortion the device.py
+    docstring documents (device.py biased_random_walk: with max_degree
+    truncation a dropped real neighbor of the PARENT classifies as
+    d_tx=2 (1/q) instead of d_tx=1, on top of the truncated sampling
+    support of the CURRENT node).
+
+    Both one-step transition distributions are computed ANALYTICALLY
+    (no sampling noise): the exact node2vec distribution from the host
+    engine's full neighbor lists (reference BuildWeights semantics,
+    euler/client/graph.cc:120-151) vs the truncated-slab model that
+    mirrors build_adjacency(sorted=True, max_degree=W) +
+    biased_random_walk exactly. Steps measured are the AFFECTED class:
+    parent x is a truncated (hub) row, current v drawn from x's kept
+    set — any walk step with a hub parent is in this class; the
+    edge-mass share of such steps is reported alongside. Metrics per
+    cap W: mean/max total-variation distance and the mean exact-mass
+    misclassified 1 -> 1/q."""
+    import euler_tpu
+    from euler_tpu.datasets import build_powerlaw
+    from euler_tpu.graph import device as dg
+
+    n, e = num_nodes, num_edges
+    d = tempfile.mkdtemp(prefix="walk_study_")
+    build_powerlaw(d, num_nodes=n, num_edges=e, feature_dim=4,
+                   label_dim=3, alpha=1.6, seed=seed)
+    g = euler_tpu.Graph(directory=d)
+    full_nbr, full_w, _, cnt = g.get_full_neighbor(np.arange(n), [0])
+    rows = []          # per-node (ids, weights) from the host engine
+    off = 0
+    for c in cnt:
+        rows.append((full_nbr[off:off + c], full_w[off:off + c]))
+        off += c
+    rng = np.random.default_rng(seed)
+    out = {
+        "graph": {"num_nodes": n, "num_edges": int(cnt.sum()),
+                  "mean_degree": round(float(cnt.mean()), 1),
+                  "max_degree": int(cnt.max())},
+        "caps": {},
+    }
+
+    def exact_dist(x_set, x_id, v, p, q):
+        ids, w = rows[v]
+        scale = np.where(
+            ids == x_id, 1.0 / p,
+            np.where(np.isin(ids, x_set), 1.0, 1.0 / q),
+        )
+        pr = w * scale
+        return ids, pr / pr.sum()
+
+    for W in caps:
+        hubs = np.flatnonzero(cnt > W)
+        if len(hubs) == 0:
+            out["caps"][f"W{W}"] = {
+                "rows_truncated": 0,
+                "note": "cap >= observed max degree: no truncation",
+            }
+            continue
+        adj = dg.build_adjacency(g, [0], n - 1, max_degree=W, sorted=True)
+        nbr, deg = np.asarray(adj["nbr"]), np.asarray(adj["deg"])
+        cum = np.asarray(adj["cum"], dtype=np.float64)
+        # share of steps in the affected class: a step's support/classes
+        # are wrong iff its PARENT row is truncated; under a uniform
+        # edge-mass proxy that share is the edge mass leaving hub rows
+        mass_from_hubs = float(cnt[hubs].sum() / cnt.sum())
+        tvds, miscls = [], []
+        for _ in range(pairs_per_cap):
+            x = int(rng.choice(hubs))
+            kept_x = nbr[x][:deg[x]]
+            v = int(rng.choice(kept_x))
+            if cnt[v] == 0 or deg[v] == 0:
+                continue
+            x_full = rows[x][0]
+            for p, q in ((0.25, 4.0), (4.0, 0.25)):
+                ids_e, pr_e = exact_dist(x_full, x, v, p, q)
+                ids_set = {int(i) for i in ids_e}
+                # truncated model: v's kept slots + weights from cum
+                # diffs; membership against x's KEPT sorted row
+                kv = nbr[v][:deg[v]]
+                wv = np.diff(np.concatenate([[0.0], cum[v][:deg[v]]]))
+                pos = np.searchsorted(kept_x, kv)
+                in_x = (pos < deg[x]) & (
+                    kept_x[np.clip(pos, 0, deg[x] - 1)] == kv
+                )
+                sc = np.where(
+                    kv == x, 1.0 / p, np.where(in_x, 1.0, 1.0 / q)
+                )
+                pr_t = wv * sc
+                pr_t = pr_t / pr_t.sum()
+                t = {int(y): 0.0 for y in ids_set}
+                for i, y in enumerate(kv):
+                    t[int(y)] = t.get(int(y), 0.0) + pr_t[i]
+                tvd = 0.5 * (
+                    sum(abs(t.get(int(y), 0.0) - pe)
+                        for y, pe in zip(ids_e, pr_e))
+                    + sum(v2 for y, v2 in t.items()
+                          if y not in ids_set)
+                )
+                tvds.append(tvd)
+                # exact mass whose CLASS flips 1 -> 1/q: candidates the
+                # device still reaches (in v's kept row) that are real
+                # neighbors of x but absent from x's kept row. Mass on
+                # candidates dropped from v's row is SUPPORT truncation,
+                # counted by the TVD, not here.
+                flipped = (
+                    np.isin(ids_e, x_full)
+                    & ~np.isin(ids_e, kept_x)
+                    & np.isin(ids_e, kv)
+                )
+                miscls.append(float(pr_e[flipped].sum()))
+        entry = {
+            "rows_truncated": int(len(hubs)),
+            "edge_mass_from_truncated_rows": round(mass_from_hubs, 4),
+        }
+        if tvds:  # all-dead-end draws leave no valid pairs; avoid NaN
+            entry.update(
+                mean_tvd=round(float(np.mean(tvds)), 4),
+                max_tvd=round(float(np.max(tvds)), 4),
+                mean_exact_mass_misclassified=round(
+                    float(np.mean(miscls)), 4
+                ),
+            )
+        else:
+            entry["note"] = "no valid (hub parent, sampleable v) pairs"
+        out["caps"][f"W{W}"] = entry
+    return out
+
+
 def truncation_study(steps: int, batch: int) -> dict:
     """Train the same GraphSAGE on a heavy-tailed planted graph under
     each sampler form; report val micro-F1 + final loss."""
@@ -230,6 +364,7 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--full", action="store_true")
     ap.add_argument("--truncation-study", action="store_true")
+    ap.add_argument("--walk-study", action="store_true")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--num-edges", type=int, default=114_600_000,
                     help="edge target; the generator (unique-fill + "
@@ -245,18 +380,16 @@ def main() -> None:
         out["truncation_study"] = truncation_study(
             args.study_steps, args.study_batch
         )
+    if args.walk_study:
+        out["walk_study"] = walk_study()
     if args.full:
         # default to the SAME cache bench.py's reddit_heavytail config
-        # uses (EULER_TPU_HEAVYTAIL_CACHE override, <repo>/.data
-        # otherwise) so the documented script-then-bench queue builds
-        # the ~2 GB graph once, not twice
-        wd = args.workdir or os.environ.get(
-            "EULER_TPU_HEAVYTAIL_CACHE",
-            os.path.join(
-                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                ".data", "reddit_ht",
-            ),
-        )
+        # uses (one resolver: datasets.heavytail_cache_dir) so the
+        # documented script-then-bench queue builds the ~2 GB graph
+        # once, not twice
+        from euler_tpu.datasets import heavytail_cache_dir
+
+        wd = args.workdir or heavytail_cache_dir()
         out["full_scale"] = full_scale(
             wd, args.num_edges, args.batch, args.steps
         )

@@ -43,10 +43,13 @@ fi
 CFGS="reddit,ppi"
 if python -c "
 import sys
-from euler_tpu.datasets import REDDIT_HEAVYTAIL, powerlaw_cache_ready
-import os
-cache = os.environ.get('EULER_TPU_HEAVYTAIL_CACHE', '.data/reddit_ht')
-sys.exit(0 if powerlaw_cache_ready(cache, **REDDIT_HEAVYTAIL) else 1)
+from euler_tpu.datasets import (
+    REDDIT_HEAVYTAIL, heavytail_cache_dir, powerlaw_cache_ready,
+)
+sys.exit(
+    0 if powerlaw_cache_ready(heavytail_cache_dir(), **REDDIT_HEAVYTAIL)
+    else 1
+)
 "; then
   CFGS="reddit_heavytail,$CFGS"
   # three configs share one in-process watchdog window; the heavytail
