@@ -40,6 +40,16 @@ Robustness contract (the driver records this output unattended):
 - TPU backend init is probed in a killable subprocess with bounded
   retries/backoff, so a hung or busy chip can never hang this process or
   leave a child holding it.
+- EVERY config's measurement runs in its OWN killable subprocess that
+  BANKS its JSON result to disk (<repo>/.bench_bank/<config>.json,
+  override EULER_TPU_BENCH_BANK) the moment it exists — the host-path
+  number is banked mid-config before the device-sampling section starts,
+  so a relay that wedges AFTER a successful probe (the round-4 failure
+  mode: good probe, then backend init blocked 19 min at 0% CPU) costs at
+  most one config's remaining work, never the whole window. The parent
+  process never initializes a backend itself; a wedged child is
+  SIGKILLed at its per-config deadline and the parent falls back to CPU
+  for that config and the rest.
 - If the TPU never comes up, the benchmark still runs on CPU and reports
   the measured number with an "error" field naming the TPU failure.
 - Any other failure still prints the headline JSON line with "error".
@@ -60,7 +70,6 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time
 
 import numpy as np
@@ -229,10 +238,13 @@ def _timed(fn, out_list):
     return wrapper
 
 
-def run_config(name: str, cfg: dict, trace_dir: str | None):
+def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
     """Train supervised GraphSAGE at cfg's scale, measuring pipelined
     throughput plus the host/device step-time split. Returns the result
-    JSON dict."""
+    JSON dict. ``bank``, when given, is called with the host-path-only
+    result BEFORE the device-sampling section starts (and callers bank
+    the final dict themselves) — a wedge mid-config then loses the
+    device-sampling delta, not the whole config."""
     import jax
 
     import euler_tpu
@@ -379,6 +391,65 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
     )
     sps = measure / dt
     edges_per_sec = edges_per_step * sps / n_chips
+
+    host_bogus = _implausible(step_wall_ms, losses[-1])
+    if host_bogus:
+        # the host-path window is this metric's floor; if even it is
+        # fake, the whole config's numbers are untrustworthy — and there
+        # is no point burning the device-sampling window on it
+        return {
+            **_failure_line(name, f"measurement rejected: {host_bogus}"),
+            "detail": {"config": name, "platform": platform},
+        }
+
+    def _mk_result(ds: dict) -> dict:
+        e_s, s_s = edges_per_sec, sps
+        if ds.get("edges_per_sec", 0) > e_s and "implausible" not in ds:
+            e_s, s_s = ds["edges_per_sec"], ds["steps_per_sec"]
+        return {
+            "metric": (
+                f"{name}_edges/sec/chip" if name != "ppi" else "edges/sec/chip"
+            ),
+            "value": round(e_s, 1),
+            "unit": "edges/s",
+            "vs_baseline": round(e_s / BASELINE_TARGET, 3),
+            "detail": {
+                "config": name,
+                "steps_per_sec": round(s_s, 2),
+                "batch": batch_size,
+                "fanouts": fanouts,
+                "dim": dim,
+                "chips": n_chips,
+                "platform": platform,
+                "final_loss": round(float(np.asarray(losses[-1])), 4),
+                "device_sampling": ds,
+                "host_path_edges_per_sec": round(
+                    edges_per_step * (measure / dt) / n_chips, 1
+                ),
+                "breakdown": {
+                    "host_sample_ms_per_batch": round(host_sample_ms, 2),
+                    "device_step_ms": round(device_step_ms, 2),
+                    "pipelined_step_wall_ms": round(step_wall_ms, 2),
+                    "input_stall_ms": round(
+                        max(0.0, step_wall_ms - device_step_ms), 2
+                    ),
+                    # hidden = the pipelined wall is close to pure device
+                    # time, i.e. the input pipeline adds <20% stall
+                    "sampling_hidden_by_prefetch": bool(
+                        step_wall_ms < device_step_ms * 1.2
+                    ),
+                    # achieved vs peak (mfu / hbm_util) — the denominator
+                    # for "is the step actually fast"; see PERF.md
+                    "roofline": host_roofline,
+                },
+                "trace_dir": trace_dir,
+            },
+        }
+
+    if bank is not None:
+        partial = _mk_result({})
+        partial["detail"]["banked"] = "host_path_only"
+        bank(partial)
 
     # Device-sampling path: adjacency in HBM, roots + fanout sampled
     # inside the jitted step, lax.scan chaining CHUNK steps per dispatch
@@ -536,64 +607,106 @@ def run_config(name: str, cfg: dict, trace_dir: str | None):
     except Exception as e:  # never lose the host-path number
         ds["error"] = f"{type(e).__name__}: {e}"[:300]
 
-    host_bogus = _implausible(step_wall_ms, losses[-1])
-    if host_bogus:
-        # the host-path window is this metric's floor; if even it is
-        # fake, the whole config's numbers are untrustworthy
-        return {
-            "metric": (
-                f"{name}_edges/sec/chip" if name != "ppi" else "edges/sec/chip"
-            ),
-            "value": 0.0,
-            "unit": "edges/s",
-            "vs_baseline": 0.0,
-            "error": f"measurement rejected: {host_bogus}",
-            "detail": {"config": name, "platform": platform,
-                       "device_sampling": ds},
-        }
-    if (
-        ds.get("edges_per_sec", 0) > edges_per_sec
-        and "implausible" not in ds
-    ):
-        edges_per_sec = ds["edges_per_sec"]
-        sps = ds["steps_per_sec"]
-    return {
-        "metric": f"{name}_edges/sec/chip" if name != "ppi" else "edges/sec/chip",
-        "value": round(edges_per_sec, 1),
-        "unit": "edges/s",
-        "vs_baseline": round(edges_per_sec / BASELINE_TARGET, 3),
-        "detail": {
-            "config": name,
-            "steps_per_sec": round(sps, 2),
-            "batch": batch_size,
-            "fanouts": fanouts,
-            "dim": dim,
-            "chips": n_chips,
-            "platform": platform,
-            "final_loss": round(float(np.asarray(losses[-1])), 4),
-            "device_sampling": ds,
-            "host_path_edges_per_sec": round(
-                edges_per_step * (measure / dt) / n_chips, 1
-            ),
-            "breakdown": {
-                "host_sample_ms_per_batch": round(host_sample_ms, 2),
-                "device_step_ms": round(device_step_ms, 2),
-                "pipelined_step_wall_ms": round(step_wall_ms, 2),
-                "input_stall_ms": round(
-                    max(0.0, step_wall_ms - device_step_ms), 2
-                ),
-                # hidden = the pipelined wall is close to pure device
-                # time, i.e. the input pipeline adds <20% stall
-                "sampling_hidden_by_prefetch": bool(
-                    step_wall_ms < device_step_ms * 1.2
-                ),
-                # achieved vs peak (mfu / hbm_util) — the denominator for
-                # "is the step actually fast"; see PERF.md roofline notes
-                "roofline": host_roofline,
-            },
-            "trace_dir": trace_dir,
-        },
-    }
+    return _mk_result(ds)
+
+
+# Per-config wall-time caps (seconds, TPU base — x3 on CPU): the
+# subprocess running a config is SIGKILLed at its cap, so one wedged
+# config can never eat the following configs' window. heavytail gets
+# headroom for the 1.37 GB alias-table upload through the tunnel.
+CONFIG_CAPS = {"ppi": 900.0, "reddit": 900.0, "reddit_heavytail": 1500.0}
+
+
+def _bank_write(path: str, obj: dict) -> None:
+    """Atomic JSON write (tmp + rename): the parent may read the file
+    right after killing the writer, and a torn half-written JSON would
+    turn a banked partial result into nothing."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _run_one(name: str, bank_file: str, platform: str | None,
+             trace_dir: str | None) -> None:
+    """Child mode: measure ONE config in this process, bank the result
+    (host-path partial first, final overwrite) to bank_file. stdout
+    stays JSON-free — the parent owns the driver-facing stream."""
+    if platform == "cpu":
+        from euler_tpu.parallel import force_cpu_devices
+
+        force_cpu_devices(1)
+    else:
+        from euler_tpu.parallel import honor_jax_platforms_env
+
+        honor_jax_platforms_env()
+    try:
+        result = run_config(
+            name, CONFIGS[name], trace_dir,
+            bank=lambda obj: _bank_write(bank_file, obj),
+        )
+    except Exception as e:  # noqa: BLE001 — bank the failure line too
+        result = _failure_line(name, f"{type(e).__name__}: {e}")
+    result.setdefault("detail", {})["banked"] = "final"
+    _bank_write(bank_file, result)
+
+
+def _spawn_config(name: str, platform: str | None, timeout_s: float,
+                  bank_dir: str, trace_dir: str | None):
+    """Run one config in a killable subprocess; return (result,
+    timed_out) where result is its banked JSON (final, or the mid-config
+    host-path partial if the child died after banking it) or None when
+    nothing was banked, and timed_out reports whether the child hit its
+    deadline (the parent's cue that the backend wedged even when a
+    partial was rescued). The child is its own session so a SIGKILL
+    reaps any grandchildren with it."""
+    import signal
+    import subprocess
+
+    bank_file = os.path.join(bank_dir, f"{name}.json")
+    try:
+        os.remove(bank_file)  # stale banks must not pass as this run's
+    except OSError:
+        pass
+    cmd = [
+        sys.executable, "-u", os.path.abspath(__file__),
+        "--run-one", name, "--bank-file", bank_file,
+    ]
+    if platform:
+        cmd += ["--platform", platform]
+    if trace_dir:
+        cmd += ["--trace-dir", trace_dir]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.DEVNULL, start_new_session=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    timed_out = False
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        proc.wait()
+    result = None
+    if os.path.exists(bank_file):
+        try:
+            with open(bank_file) as f:
+                result = json.load(f)
+        except ValueError:
+            result = None
+    if result is not None and result.get("detail", {}).get("banked") != "final":
+        how = (
+            f"killed at the {timeout_s:.0f}s config deadline"
+            if timed_out else f"child exited rc={proc.returncode}"
+        )
+        result["error"] = (
+            f"{how} mid-config; host-path partial measurement banked "
+            "(device-sampling section lost — relay wedge?)"
+        )
+    return result, timed_out
 
 
 def main() -> None:
@@ -609,17 +722,42 @@ def main() -> None:
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("EULER_TPU_PROBE_TIMEOUT", 150)))
     ap.add_argument("--probe-backoff", type=float, default=20.0)
+    ap.add_argument(
+        "--deadline", type=float, default=None,
+        help="total wall budget in seconds, scaled x3 on CPU fallback "
+        "(unlike EULER_TPU_BENCH_DEADLINE, which is honored as-is)",
+    )
+    # child-mode flags (internal: the parent spawns `--run-one <config>`)
+    ap.add_argument("--run-one", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--bank-file", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--trace-dir", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.run_one:
+        _run_one(args.run_one, args.bank_file, args.platform, args.trace_dir)
+        return
 
     names = [n.strip() for n in args.configs.split(",") if n.strip()]
     # headline last so the driver's last-line parse records it
     names.sort(key=lambda n: n == "ppi")
 
+    bank_dir = os.environ.get(
+        "EULER_TPU_BENCH_BANK",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_bank"),
+    )
+    os.makedirs(bank_dir, exist_ok=True)
+
     tpu_error = None
     platform = None
     # one gate for "JAX_PLATFORMS could resolve to the chip": the probe
-    # branch and the watchdog's CPU-deadline scaling must never disagree
-    tpu_possible = os.environ.get("JAX_PLATFORMS", "") in ("", "axon", "tpu")
+    # branch and the CPU-deadline scaling must never disagree. First
+    # element of a comma list decides, matching probe_backend_or_die
+    # ("tpu,cpu" still inits TPU first).
+    tpu_possible = os.environ.get(
+        "JAX_PLATFORMS", ""
+    ).split(",")[0].strip() in ("", "axon", "tpu")
     if tpu_possible:
         platform, tpu_error = probe_backend(
             args.probe_attempts, args.probe_timeout, args.probe_backoff
@@ -629,79 +767,132 @@ def main() -> None:
             # no number (round-1 failure mode)
             tpu_error = f"TPU backend unavailable ({tpu_error}); CPU fallback"
             print(json.dumps({"note": tpu_error}), file=sys.stderr)
-            from euler_tpu.parallel import force_cpu_devices
 
-            force_cpu_devices(1)
-    else:
-        from euler_tpu.parallel import honor_jax_platforms_env
-
-        honor_jax_platforms_env()
-
-    # Watchdog (started AFTER the probe: probe children have their own
-    # subprocess timeouts, and a hard-exit mid-probe would orphan a child
-    # holding the chip): a relay that wedges after a successful probe
-    # leaves this process blocked in a C-level device wait that Python
-    # signal handlers cannot interrupt — a daemon thread can still print
-    # the driver-parseable failure line and hard-exit before the driver's
-    # own timeout would record nothing at all.
-    explicit_deadline = "EULER_TPU_BENCH_DEADLINE" in os.environ
-    try:
-        deadline = float(os.environ.get("EULER_TPU_BENCH_DEADLINE", 2400))
-    except ValueError:
-        deadline = 2400.0
-        explicit_deadline = False  # value discarded -> nothing honored
-    if deadline <= 0:
-        deadline = 2400.0
-        explicit_deadline = False
     # CPU is legitimately ~an order of magnitude slower than the chip —
     # whether via probe fallback (tpu_error) or an explicit
     # JAX_PLATFORMS=cpu run; a healthy-but-slow CPU run must not be
-    # reported as a wedged backend, so the default deadline scales up
-    # (an explicit, parseable env deadline is honored as-is)
-    # CPU three ways: probe failed (tpu_error), JAX_PLATFORMS forced a
-    # non-TPU backend, or the probe succeeded but the ambient backend IS
-    # cpu (TPU-less machine, JAX_PLATFORMS unset)
+    # reported as a wedged backend, so the default (and --deadline)
+    # budget scales up. An explicit, parseable EULER_TPU_BENCH_DEADLINE
+    # env var is honored as-is (back-compat). CPU three ways: probe
+    # failed, JAX_PLATFORMS forced a non-TPU backend, or the probe
+    # succeeded but the ambient backend IS cpu (TPU-less machine).
     on_cpu = (
         tpu_error is not None
         or not tpu_possible
         or platform not in ("tpu", "axon")
     )
-    if on_cpu and not explicit_deadline:
+    env_deadline = os.environ.get("EULER_TPU_BENCH_DEADLINE")
+    deadline = None
+    scale_cpu = True
+    if args.deadline is not None and args.deadline > 0:
+        deadline = args.deadline
+    elif env_deadline is not None:
+        try:
+            deadline = float(env_deadline)
+            scale_cpu = False
+        except ValueError:
+            deadline = None
+        if deadline is not None and deadline <= 0:
+            deadline = None
+    if deadline is None:
+        deadline, scale_cpu = 2400.0, True
+    if on_cpu and scale_cpu:
         deadline *= 3.0
+    t_end = time.monotonic() + deadline
 
-    # the watchdog names whichever config was actually running when the
-    # deadline hit (not unconditionally the headline)
-    running = {"config": None}
-
-    def _watchdog():
-        time.sleep(deadline)
+    def _watchdog_exit(config: str) -> None:
         # headline ("ppi") metric shape so the driver's last-line parse
         # always sees the contract, but the error names the config that
         # was actually on the clock
         print(json.dumps(_failure_line(
             "ppi",
             f"bench watchdog: exceeded {deadline:.0f}s during config "
-            f"{running['config'] or '<pre-run>'} (backend hang mid-run?)",
+            f"{config} (backend hang mid-run?)",
         )), flush=True)
-        os._exit(2)
-
-    threading.Thread(target=_watchdog, daemon=True).start()
+        sys.exit(2)
 
     trace_dir = os.environ.get(
         "EULER_TPU_PROFILE_DIR", "/tmp/euler_tpu_bench_trace"
     )
+    history = os.path.join(bank_dir, "history.jsonl")
+
+    def _emit(result: dict) -> dict:
+        with open(history, "a") as f:
+            f.write(json.dumps(
+                {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 **result}
+            ) + "\n")
+        return result
+
+    # Children inherit the ambient platform (None — honoring whatever
+    # JAX_PLATFORMS says) until a probe failure or a mid-run child wedge
+    # forces the CPU backend for everything after, so one wedge cannot
+    # cascade. Probe-failure fallback forces CPU outright.
+    child_platform = "cpu" if tpu_error is not None else None
+    cap_scale = 3.0 if on_cpu else 1.0
+    tpu_live = not on_cpu
+
+    def _go_cpu(note: str) -> None:
+        # mid-run downgrade: force CPU for the remaining configs and,
+        # when the budget was sized for a TPU run, extend it to the
+        # CPU-scaled budget a CPU run would have had from the start —
+        # a healthy-but-slow CPU fallback must not be misreported as a
+        # watchdog "backend hang" (the external tpu_checks deadline
+        # already covers 3x the base)
+        nonlocal child_platform, cap_scale, tpu_live, tpu_error, t_end
+        tpu_error = note
+        print(json.dumps({"note": note}), file=sys.stderr)
+        child_platform, cap_scale = "cpu", 3.0
+        if tpu_live and scale_cpu:
+            t_end += deadline * 2.0
+        tpu_live = False
+
     headline = None
     for name in names:
-        running["config"] = name
-        try:
-            result = run_config(
-                name, CONFIGS[name],
-                trace_dir if name == "ppi" else None,
+        remaining = t_end - time.monotonic()
+        if remaining <= 0:
+            _watchdog_exit(name)
+        cap = CONFIG_CAPS.get(name, 900.0) * cap_scale
+        result, timed_out = _spawn_config(
+            name, child_platform, min(cap, remaining), bank_dir,
+            trace_dir if name == "ppi" else None,
+        )
+        if result is None and tpu_live:
+            # TPU child died with nothing banked: relay wedge before the
+            # first measurement (the round-4 "good probe, wedged init"
+            # mode). Retry this config on CPU — partial window beats
+            # empty window.
+            _go_cpu(
+                f"TPU config subprocess for {name} produced no result "
+                f"within {min(cap, remaining):.0f}s (relay wedge after "
+                "successful probe); CPU fallback"
             )
-            if tpu_error:
-                result["error"] = tpu_error
-        except Exception as e:  # noqa: BLE001 — always emit the JSON line
-            result = _failure_line(name, f"{type(e).__name__}: {e}")
+            remaining = t_end - time.monotonic()
+            if remaining > 60:
+                result, timed_out = _spawn_config(
+                    name, "cpu",
+                    min(CONFIG_CAPS.get(name, 900.0) * 3.0, remaining),
+                    bank_dir, trace_dir if name == "ppi" else None,
+                )
+        elif timed_out and tpu_live:
+            # the child wedged but its host-path partial was rescued:
+            # keep that (it IS a TPU measurement) and stop trusting the
+            # relay for the remaining configs
+            _go_cpu(
+                f"TPU config subprocess for {name} hit its "
+                f"{min(cap, remaining):.0f}s deadline after banking a "
+                "partial result (relay wedge mid-config); CPU fallback "
+                "for the remaining configs"
+            )
+        if result is None:
+            if time.monotonic() >= t_end:
+                _watchdog_exit(name)
+            result = _failure_line(
+                name, "config subprocess produced no banked result"
+            )
+        if tpu_error and "error" not in result:
+            result["error"] = tpu_error
+        _emit(result)
         if name == "ppi":
             headline = result
         else:

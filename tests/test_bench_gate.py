@@ -125,6 +125,40 @@ def test_probe_or_die_fails_fast_and_reprobes(monkeypatch):
     assert not mesh._probed_ok
 
 
+def test_bank_write_atomic(bench, tmp_path):
+    p = str(tmp_path / "x.json")
+    bench._bank_write(p, {"a": 1})
+    bench._bank_write(p, {"a": 2})
+    import json
+
+    assert json.load(open(p)) == {"a": 2}
+    assert not os.path.exists(p + ".tmp")
+
+
+def test_spawn_config_banks_child_failure_as_final(bench, tmp_path):
+    """The child process banks even its failure line (marked final), so
+    the parent distinguishes 'config failed' from 'child wedged before
+    banking anything'."""
+    r, timed_out = bench._spawn_config(
+        "no_such_config", "cpu", 120.0, str(tmp_path), None
+    )
+    assert r is not None and not timed_out
+    assert r["value"] == 0.0 and "KeyError" in r["error"]
+    assert r["detail"]["banked"] == "final"
+
+
+def test_spawn_config_kills_wedged_child(bench, tmp_path):
+    """A child that banks nothing within its deadline is SIGKILLed and
+    reported as None — the parent's cue to fall back / move on (the
+    round-4 wedge mode: successful probe, then a blocked backend init
+    eating the whole window)."""
+    t0 = __import__("time").monotonic()
+    r, timed_out = bench._spawn_config("ppi", "cpu", 3.0, str(tmp_path), None)
+    dt = __import__("time").monotonic() - t0
+    assert r is None and timed_out
+    assert dt < 30, f"kill took {dt:.0f}s"
+
+
 def test_heavytail_config_has_no_shape_literals(bench):
     """The reddit_heavytail graph shape comes from
     datasets.REDDIT_HEAVYTAIL at run time (run_config merges it in); a
