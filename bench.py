@@ -108,6 +108,18 @@ CONFIGS = {
         multilabel=False, batch=1000, fanouts=(4, 4), dim=64, lr=0.03,
         warmup=3, measure=15,
     ),
+    # the same recipe with a bfloat16 feature table: Reddit's 602-dim
+    # rows are the wide-gather case the reduced-precision table exists
+    # for (the feature gathers are the post-kernel bottleneck, PERF.md
+    # step anatomy) — compare against the reddit line for the f32/bf16
+    # A/B. Reference analog: PS-side feature storage,
+    # tf_euler/python/utils/embedding.py:22-67.
+    "reddit_bf16": dict(
+        num_nodes=232965, avg_degree=50, feature_dim=602, label_dim=41,
+        multilabel=False, batch=1000, fanouts=(4, 4), dim=64, lr=0.03,
+        warmup=3, measure=15, feature_dtype="bfloat16",
+        cache_as="reddit",  # identical graph: share the on-disk cache
+    ),
     # real-degree Reddit: power-law out/in-degrees at the real edge
     # budget (unique-fill + Gumbel-top-k hub rows land the achieved
     # count <1% under num_edges; measured 0.8% under at this recipe).
@@ -291,7 +303,7 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
     else:
         cache = os.environ.get(
             "EULER_TPU_BENCH_CACHE", "/tmp/euler_tpu_bench"
-        ) + "_" + name
+        ) + "_" + cfg.get("cache_as", name)
         build_synthetic(
             cache,
             num_nodes=cfg["num_nodes"],
@@ -312,6 +324,7 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
         feature_dim=cfg["feature_dim"],
         max_id=cfg["num_nodes"] - 1,
         device_features=True,
+        feature_dtype=cfg.get("feature_dtype"),
     )
 
     mesh = make_mesh()
@@ -469,6 +482,7 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
             max_id=cfg["num_nodes"] - 1,
             device_features=True,
             device_sampling=True,
+            feature_dtype=cfg.get("feature_dtype"),
         )
         if cfg.get("alias_sampling"):
             # exact flat-CSR alias sampler: the only buildable device
@@ -614,7 +628,12 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
 # subprocess running a config is SIGKILLed at its cap, so one wedged
 # config can never eat the following configs' window. heavytail gets
 # headroom for the 1.37 GB alias-table upload through the tunnel.
-CONFIG_CAPS = {"ppi": 900.0, "reddit": 900.0, "reddit_heavytail": 1500.0}
+CONFIG_CAPS = {
+    "ppi": 900.0,
+    "reddit": 900.0,
+    "reddit_bf16": 900.0,
+    "reddit_heavytail": 1500.0,
+}
 
 
 def _bank_write(path: str, obj: dict) -> None:

@@ -427,8 +427,11 @@ def build_powerlaw(
             # exact weighted sample WITHOUT replacement (Gumbel top-k /
             # Efraimidis-Spirakis race): perturb log-weights with Gumbel
             # noise, keep the d largest — every row lands exactly d
-            # unique neighbors with the preferential distribution
-            g = log_w - np.log(-np.log(rng.random(num_nodes)))
+            # unique neighbors with the preferential distribution.
+            # Uniforms clipped away from 0: log(0) would emit a
+            # divide-by-zero warning (the -inf key itself is harmless)
+            u = np.maximum(rng.random(num_nodes), np.finfo(np.float64).tiny)
+            g = log_w - np.log(-np.log(u))
             nbrs = np.argpartition(g, num_nodes - d)[num_nodes - d:]
         else:
             # unique-fill: redraw the duplicate shortfall (bounded

@@ -639,18 +639,20 @@ uint64_t GraphStore::BiasedNeighbor(int64_t nidx, bool has_parent,
   int64_t parent_idx = NodeIndex(parent_id);
   if (parent_idx >= 0)
     FullNeighbors(parent_idx, etypes, net, true, &pids, &pw, &pt);
-  // d_tx weighting (reference euler/client/graph.cc:120-151): x == parent →
-  // w/p; x adjacent to parent → w; else w/q. Sorted two-pointer intersect.
+  // d_tx weighting (reference euler/client/graph.cc:120-151): x adjacent
+  // to parent → w (this wins even for x == parent when the parent has a
+  // self-loop — the reference merge's equality branch runs first);
+  // x == parent → w/p; else w/q. Sorted two-pointer intersect.
   std::vector<float> cum(ids.size());
   double acc = 0.0;
   size_t pi = 0;
   for (size_t j = 0; j < ids.size(); ++j) {
     while (pi < pids.size() && pids[pi] < ids[j]) ++pi;
     float wj = w[j];
-    if (ids[j] == parent_id) {
-      wj /= p;
-    } else if (pi < pids.size() && pids[pi] == ids[j]) {
+    if (pi < pids.size() && pids[pi] == ids[j]) {
       // distance 1: keep wj
+    } else if (ids[j] == parent_id) {
+      wj /= p;
     } else {
       wj /= q;
     }

@@ -871,11 +871,15 @@ void RemoteGraph::RandomWalk(const uint64_t* ids, int n,
           for (size_t j = 0; j < cc; ++j) {
             uint64_t x = c_ids[c_off + j];
             float wx = c_w[c_off + j];
+            // parent-adjacency wins even for x == parent (parent with a
+            // self-loop is d_tx=1): the reference merge's equality
+            // branch runs before its candidate<parent check
+            // (euler/client/graph.cc:126-140)
             double scale;
-            if (x == parent[i])
-              scale = 1.0 / p;
-            else if (std::binary_search(pb, pb + pc, x))
+            if (std::binary_search(pb, pb + pc, x))
               scale = 1.0;
+            else if (x == parent[i])
+              scale = 1.0 / p;
             else
               scale = 1.0 / q;
             total += wx * scale;

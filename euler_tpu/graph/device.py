@@ -81,6 +81,7 @@ def build_adjacency(
     max_degree: int | None = None,
     chunk: int = 65536,
     sorted: bool = False,
+    _prefetched=None,
 ) -> dict:
     """Export the adjacency restricted to ``edge_types`` as device slabs.
 
@@ -94,8 +95,10 @@ def build_adjacency(
     """
     n_rows = max_id + 2
     default = max_id + 1
-    counts_all, nbr_flat, w_flat, offsets = _fetch_flat_csr(
-        graph, edge_types, max_id, chunk, sorted=sorted
+    counts_all, nbr_flat, w_flat, offsets = (
+        _prefetched
+        if _prefetched is not None
+        else _fetch_flat_csr(graph, edge_types, max_id, chunk, sorted=sorted)
     )
 
     W = int(counts_all.max()) if len(counts_all) else 0
@@ -169,11 +172,16 @@ def build_adjacency(
     # the dict: consts pytrees are traced through jit, where a flag leaf
     # could not be branch-checked anyway; callers keep sorted slabs under
     # distinct consts keys (Model.adj_key(et, sorted=True)).
+    # "truncated_rows" is HOST-side metadata (a plain int, popped by
+    # Model.add_sampling_consts before the dict reaches jit): biased
+    # walks on a truncated slab are measurably distorted (PERF.md walk
+    # study) and callers must be able to detect the condition.
     return {
         "nbr": nbr_out,
         "cum": cum_out,
         "deg": deg,
         "sampleable": sampleable,
+        "truncated_rows": int(len(truncated)),
     }
 
 
@@ -182,6 +190,8 @@ def build_alias_adjacency(
     edge_types,
     max_id: int,
     chunk: int = 65536,
+    sorted: bool = False,
+    _prefetched=None,
 ) -> dict:
     """Export the adjacency restricted to ``edge_types`` as device-side
     EXACT sampling structures: flat-CSR Walker alias tables, O(1) per
@@ -200,15 +210,22 @@ def build_alias_adjacency(
     e.g. ~1.4 GB for a 114M-edge Reddit-scale graph. The alias build
     itself runs in native code (eg_build_alias_csr, OpenMP over rows).
     Unknown ids and the default row sample the default node, exactly
-    like build_adjacency."""
+    like build_adjacency.
+
+    ``sorted=True`` exports id-sorted CSR rows — the precondition for
+    alias_biased_random_walk's parent-membership bisection (the alias
+    draw itself is order-independent, so sorted tables sample the same
+    distribution)."""
     import ctypes
 
     from euler_tpu.graph import native
 
     n_rows = max_id + 2
     default = max_id + 1
-    counts_all, nbr_flat, w_flat, offsets = _fetch_flat_csr(
-        graph, edge_types, max_id, chunk
+    counts_all, nbr_flat, w_flat, offsets = (
+        _prefetched
+        if _prefetched is not None
+        else _fetch_flat_csr(graph, edge_types, max_id, chunk, sorted=sorted)
     )
     e = len(nbr_flat)
     if e >= 1 << 31:
@@ -570,9 +587,14 @@ def biased_random_walk(adj, roots, key, walk_len: int, p: float, q: float):
             ) == cand
             in_parent_nbr = hit & (pos < deg[parent][:, None])
             is_parent = cand == parent[:, None]
+            # d_tx=1 wins over d_tx=0 when the parent has a self-loop:
+            # the reference merge's equality branch runs before its
+            # candidate<parent check (euler/client/graph.cc:126-140),
+            # so a candidate that IS the parent AND appears in the
+            # parent's neighbor list keeps weight w, not w/p
             scale = jnp.where(
-                is_parent, 1.0 / p,
-                jnp.where(in_parent_nbr, 1.0, 1.0 / q),
+                in_parent_nbr, 1.0,
+                jnp.where(is_parent, 1.0 / p, 1.0 / q),
             )
             w = w * scale
         cw = jnp.cumsum(w, axis=1)
@@ -589,6 +611,112 @@ def biased_random_walk(adj, roots, key, walk_len: int, p: float, q: float):
         # (Dead-ended walkers land on the default row whose weights are
         # all zero, so their scale is irrelevant.)
         parent, cur, prow = cur, nxt, cand
+        cols.append(cur)
+    return jnp.stack(cols, axis=1)
+
+
+DEFAULT_WALK_TRIALS = 64  # rejection-walk proposal budget per step: the
+# worst realistic node2vec grid point (p or q = 1/4 -> envelope M = 4,
+# acceptance >= 1/16 even when every candidate is d_tx=2) leaves
+# (1 - 1/16)^64 ~ 1.6% of steps falling back to the unbiased first
+# draw; typical p/q near 1 accept on the first or second proposal.
+
+
+def _alias_biased_step(adj, cur, parent, key, p: float, q: float,
+                       trials: int):
+    """One EXACT node2vec-biased transition over full neighbor lists:
+    propose from the current node's alias row (unbiased, O(1)), accept
+    with probability s(d_tx)/M where s is the reference's d_tx scale
+    (1 if the candidate is a parent neighbor — which wins on parent
+    self-loops, matching the reference merge's branch order,
+    euler/client/graph.cc:126-140 — else 1/p for the parent itself,
+    else 1/q) and M = max(1/p, 1, 1/q). Accepted draws are distributed
+    exactly ∝ w(y)*s(y); exhausting ``trials`` proposals falls back to
+    the first (unbiased) draw. The d_tx membership test is a fixed-depth
+    bisection of each candidate in the parent's id-sorted CSR row —
+    ``adj`` MUST come from build_alias_adjacency(..., sorted=True).
+
+    Returns [len(cur)] int32 next nodes (dead ends -> default row)."""
+    offs, degs, probs, nbrs, aliases, ok_rows = (
+        jnp.asarray(adj[k])
+        for k in ("off", "deg", "prob", "nbr", "alias", "sampleable")
+    )
+    n_rows = offs.shape[0]
+    default = n_rows - 1
+    e = int(probs.shape[0])
+    b = cur.shape[0]
+    if e == 0:
+        return jnp.full((b,), default, jnp.int32)
+    k1, k2, k3 = jax.random.split(key, 3)
+    deg = degs[cur]
+    off = offs[cur]
+    u1 = jax.random.uniform(k1, (b, trials))
+    u2 = jax.random.uniform(k2, (b, trials))
+    j = jnp.minimum(
+        (u1 * deg[:, None]).astype(jnp.int32),
+        jnp.maximum(deg[:, None] - 1, 0),
+    )
+    slot = jnp.minimum(off[:, None] + j, e - 1)
+    cand = jnp.where(u2 < probs[slot], nbrs[slot], aliases[slot])
+    # membership of each candidate in the parent's id-sorted CSR row:
+    # first flat index in [plo, phi) with nbrs[idx] >= cand. Depth
+    # covers the largest possible row (deg <= E), converged lanes
+    # no-op; a lo that converged to phi (or ran past a last-row phi==E)
+    # can never satisfy the equality check below.
+    plo = jnp.broadcast_to(offs[parent][:, None], (b, trials))
+    phi = jnp.broadcast_to(
+        (offs[parent] + degs[parent])[:, None], (b, trials)
+    )
+    pos = _bisect_first_ge(nbrs, plo, phi, cand, max(e.bit_length(), 1))
+    hit = (nbrs[jnp.clip(pos, 0, e - 1)] == cand) & (pos < phi)
+    is_par = cand == parent[:, None]
+    s = jnp.where(hit, 1.0, jnp.where(is_par, 1.0 / p, 1.0 / q))
+    m = max(1.0 / p, 1.0, 1.0 / q)
+    accept = jax.random.uniform(k3, (b, trials)) < s / m
+    # first accepted proposal; none accepted -> index 0, the first
+    # (unbiased) draw — the bounded-retry fallback
+    first = jnp.argmax(accept, axis=1)
+    pick = jnp.take_along_axis(cand, first[:, None], axis=1)[:, 0]
+    ok = ok_rows[cur] & (deg > 0)
+    return jnp.where(ok, pick, default)
+
+
+def alias_biased_random_walk(adj, roots, key, walk_len: int, p: float,
+                             q: float, trials: int | None = None):
+    """[len(roots), walk_len+1] int32 node2vec-biased walks sampled on
+    device EXACTLY over the FULL neighbor lists — the heavy-tail form of
+    biased_random_walk. Where the padded-slab walk must truncate hub
+    rows (measured mean TVD 0.35 from the exact distribution at W=512,
+    PERF.md walk study), this draws proposals from the flat-CSR alias
+    tables (no truncation, O(E) memory) and rejection-corrects them to
+    the reference's d_tx-scaled distribution
+    (euler/client/graph.cc:120-151): P(accept y) = s(y)/M, leaving
+    accepted candidates ∝ w(y)*s(y) exactly.
+
+    ``adj`` MUST be built with build_alias_adjacency(..., sorted=True)
+    (the membership bisection needs id-sorted rows). Step 0 has no
+    parent and takes the plain alias draw, exactly like the host walk;
+    dead ends chain into the default row and stay there. ``trials``
+    bounds the per-step proposal budget (default DEFAULT_WALK_TRIALS);
+    an exhausted step falls back to its first unbiased draw, a <~2%
+    event at the worst realistic p/q (see DEFAULT_WALK_TRIALS)."""
+    if trials is None:
+        trials = DEFAULT_WALK_TRIALS
+    n_rows = adj["off"].shape[0]
+    default = n_rows - 1
+    cur = jnp.asarray(roots, dtype=jnp.int32).reshape(-1)
+    cur = jnp.where(cur < 0, default, jnp.minimum(cur, default))
+    parent = jnp.full_like(cur, default)
+    cols = [cur]
+    for step in range(walk_len):
+        k = jax.random.fold_in(key, step)
+        if step == 0:
+            # no parent: plain exact alias draw (the host walk's first
+            # hop is the same unbiased draw)
+            nxt = _alias_sample_neighbor(adj, cur, k, 1)[:, 0]
+        else:
+            nxt = _alias_biased_step(adj, cur, parent, k, p, q, trials)
+        parent, cur = cur, nxt
         cols.append(cur)
     return jnp.stack(cols, axis=1)
 

@@ -251,8 +251,9 @@ class Model:
         kept — changes hub distributions, see PERF.md's truncation
         study); ``alias`` switches to the exact flat-CSR alias sampler
         (no truncation, O(edges) memory) — the recommended form for
-        power-law graphs. Sorted (biased-walk) slabs ignore ``alias``:
-        the d_tx membership test needs id-sorted rows."""
+        power-law graphs. Biased (p/q) walk adjacencies build the alias
+        form with id-sorted rows and route through the exact
+        rejection-sampled walk (device.alias_biased_random_walk)."""
         if alias and max_degree is not None:
             raise ValueError(
                 "alias sampling is exact: max_degree does not apply"
@@ -304,9 +305,7 @@ class Model:
             max_degree = self.sampling_max_degree
         # an explicit per-call cap (e.g. GCN's pad-cap slabs) always
         # means "this caller walks the slab" — never swap it for alias
-        use_alias = (
-            self.sampling_alias and not sorted and not explicit_cap
-        )
+        use_alias = self.sampling_alias and not explicit_cap
         # pack for the fused kernel on a single-device TPU (auto) or when
         # a kernel mesh is registered (per-shard shard_map path)
         use_pallas = pallas_sampling.available() or (
@@ -318,14 +317,55 @@ class Model:
             k = self.adj_key(et, sorted=sorted)
             if k not in adj:
                 if use_alias:
+                    # sorted alias rows feed the exact rejection-sampled
+                    # biased walk (alias_biased_random_walk)
                     adj[k] = device_graph.build_alias_adjacency(
-                        graph, et, self.max_id
+                        graph, et, self.max_id, sorted=sorted
                     )
                     continue
-                adj[k] = device_graph.build_adjacency(
-                    graph, et, self.max_id, max_degree=max_degree,
-                    sorted=sorted,
-                )
+                if sorted and max_degree is not None:
+                    # ENFORCED guard on the measured distortion: biased
+                    # (p/q) walks over a truncated sorted slab sample a
+                    # distribution at mean TVD ~0.35 from the reference's
+                    # on hub-parent steps (PERF.md walk study) — silently
+                    # training Node2Vec on that is not acceptable. The
+                    # CSR export is fetched ONCE and the truncation
+                    # decision made from its counts, so the guard never
+                    # allocates a throwaway (N x max_degree) slab on
+                    # exactly the heavy-tail graphs it exists for.
+                    pre = device_graph._fetch_flat_csr(
+                        graph, et, self.max_id, 65536, sorted=True
+                    )
+                    trunc = int((pre[0] > max_degree).sum())
+                    if trunc:
+                        import warnings
+
+                        warnings.warn(
+                            "add_sampling_consts: sorted slab for edge "
+                            f"types {list(et)} would truncate {trunc} "
+                            f"rows at max_degree={max_degree}; biased "
+                            "walks on a truncated slab are measurably "
+                            "distorted (mean TVD ~0.35, PERF.md walk "
+                            "study) — switching this walk adjacency to "
+                            "the exact alias+rejection form"
+                        )
+                        adj[k] = device_graph.build_alias_adjacency(
+                            graph, et, self.max_id, sorted=True,
+                            _prefetched=pre,
+                        )
+                        continue
+                    slab = device_graph.build_adjacency(
+                        graph, et, self.max_id, max_degree=max_degree,
+                        sorted=True, _prefetched=pre,
+                    )
+                else:
+                    slab = device_graph.build_adjacency(
+                        graph, et, self.max_id, max_degree=max_degree,
+                        sorted=sorted,
+                    )
+                # host-side metadata, never part of the traced consts
+                slab.pop("truncated_rows", 0)
+                adj[k] = slab
                 if use_pallas and not sorted:
                     # packed slab routes sample_neighbor through the
                     # fused Pallas kernel (sorted slabs feed biased

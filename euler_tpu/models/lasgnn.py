@@ -18,7 +18,7 @@ import numpy as np
 from euler_tpu import ops
 from euler_tpu.models import base
 from euler_tpu.nn import metrics
-from euler_tpu.nn.encoders import SageEncoder
+from euler_tpu.nn.encoders import SparseSageEncoder
 from euler_tpu.nn.layers import SparseEmbedding
 
 
@@ -37,24 +37,6 @@ class DotAttention(nn.Module):
         similarity = jnp.sum(inputs * kernel, axis=-1)
         coef = nn.softmax(similarity, axis=-1)
         return jnp.sum(inputs * coef[..., None], axis=-2)
-
-
-class _SparseSageTower(nn.Module):
-    """SparseSageEncoder (reference encoders.py:522-560): sparse-feature
-    embeddings (16 per slot, shared across towers via module sharing) + Sage
-    aggregation."""
-
-    fanouts: Sequence[int]
-    dim: int
-    aggregator: str
-    concat: bool
-
-    @nn.compact
-    def __call__(self, hops_features):
-        # hops_features: list of per-hop [n_h, d0] already-embedded features
-        return SageEncoder(
-            tuple(self.fanouts), self.dim, self.aggregator, self.concat
-        )(hops_features)
 
 
 class _LasGNNModule(nn.Module):
@@ -77,11 +59,15 @@ class _LasGNNModule(nn.Module):
         self.sparse_embeddings = [
             SparseEmbedding(d + 2, 16) for d in self.feature_dims
         ]
+        # each tower is the public SparseSageEncoder (reference
+        # encoders.py:522-560) sharing ONE embedding set across every
+        # metapath tower (reference lasgnn.py:93-94 shared_embeddings)
         self.towers = [
             [
-                _SparseSageTower(
-                    tuple(self.fanouts), self.dim, self.aggregator,
-                    self.concat,
+                SparseSageEncoder(
+                    tuple(self.fanouts), self.dim,
+                    aggregator=self.aggregator, concat=self.concat,
+                    shared_embeddings=self.sparse_embeddings,
                 )
                 for _ in range(m)
             ]
@@ -90,16 +76,6 @@ class _LasGNNModule(nn.Module):
         self.attentions = [DotAttention() for _ in self.metapath_counts]
         self.target_ff = nn.Dense(self.dim)
         self.context_ff = nn.Dense(self.dim)
-
-    def _embed_hops(self, hops):
-        out = []
-        for hop in hops:
-            embs = [
-                emb(ids, mask)
-                for emb, (ids, mask) in zip(self.sparse_embeddings, hop["sparse"])
-            ]
-            out.append(jnp.concatenate(embs, axis=-1))
-        return out
 
     def _device_groups(self, batch, consts, only_target: bool = False):
         """The per-group/per-metapath hop structure built inside jit:
@@ -152,7 +128,7 @@ class _LasGNNModule(nn.Module):
         ):
             per_metapath = []
             for m, tower in enumerate(towers):
-                hops = self._embed_hops(groups[g][m]["hops"])
+                hops = [h["sparse"] for h in groups[g][m]["hops"]]
                 emb = tower(hops)  # [B*n_g, dim]
                 per_metapath.append(emb.reshape(-1, n_g, emb.shape[-1]))
             stack = jnp.stack(per_metapath, axis=-2)  # [B, n_g, M, dim]
@@ -167,7 +143,7 @@ class _LasGNNModule(nn.Module):
         per_metapath = []
         n_g = self.group_sizes[0]
         for m, tower in enumerate(self.towers[0]):
-            hops = self._embed_hops(groups[0][m]["hops"])
+            hops = [h["sparse"] for h in groups[0][m]["hops"]]
             emb = tower(hops)
             per_metapath.append(emb.reshape(-1, n_g, emb.shape[-1]))
         stack = jnp.stack(per_metapath, axis=-2)

@@ -93,10 +93,19 @@ class _ShallowUnsupModule(nn.Module):
                         "add_sampling_consts(sorted=True) and pass the "
                         "matching adj_key(et, sorted=True)"
                     )
-                paths = device_graph.biased_random_walk(
-                    adj, roots, k_walk, self.walk_len,
-                    self.walk_p, self.walk_q,
-                )
+                if "off" in adj:
+                    # flat-CSR alias form (chosen by set_sampling_options
+                    # or forced by the truncation guard): the rejection-
+                    # sampled walk is exact over FULL neighbor lists
+                    paths = device_graph.alias_biased_random_walk(
+                        adj, roots, k_walk, self.walk_len,
+                        self.walk_p, self.walk_q,
+                    )
+                else:
+                    paths = device_graph.biased_random_walk(
+                        adj, roots, k_walk, self.walk_len,
+                        self.walk_p, self.walk_q,
+                    )
             else:
                 paths = device_graph.random_walk(
                     adj, roots, k_walk, self.walk_len

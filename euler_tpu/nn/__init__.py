@@ -4,6 +4,7 @@ from euler_tpu.nn.encoders import (
     SageEncoder,
     ScalableSageEncoder,
     ShallowEncoder,
+    SparseSageEncoder,
 )
 
 __all__ = [
@@ -15,4 +16,5 @@ __all__ = [
     "SageEncoder",
     "ScalableSageEncoder",
     "ShallowEncoder",
+    "SparseSageEncoder",
 ]

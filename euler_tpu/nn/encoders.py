@@ -112,6 +112,55 @@ class SageEncoder(nn.Module):
         return hidden[0]
 
 
+class SparseSageEncoder(nn.Module):
+    """Sparse-feature GraphSAGE (reference encoders.py:522-560): per-slot
+    SparseEmbedding lookups (embedding_dim each, concatenated — the
+    reference hardcodes 16) feed SageEncoder aggregation.
+
+    ``hops`` is the per-hop list of per-slot (ids, mask) padded sparse
+    features (hop h sized batch * prod(fanouts[:h]); the 'sparse' entry
+    of the feats-dict convention above). Pass already-constructed
+    SparseEmbedding modules via ``shared_embeddings`` to tie the tables
+    across towers (the reference's shared_embeddings argument) — LasGNN
+    shares one set across all its metapath towers this way."""
+
+    fanouts: Sequence[int]
+    dim: int
+    feature_dims: Sequence[int] = ()  # per-slot max sparse id
+    aggregator: str = "mean"
+    concat: bool = False
+    embedding_dim: int = 16
+    shared_embeddings: Optional[Sequence[SparseEmbedding]] = None
+
+    def setup(self):
+        if self.shared_embeddings is not None:
+            self.sparse_embeddings = list(self.shared_embeddings)
+        else:
+            # feature_dim + 1 sparse slots plus the padding id
+            self.sparse_embeddings = [
+                SparseEmbedding(d + 2, self.embedding_dim)
+                for d in self.feature_dims
+            ]
+        self.sage = SageEncoder(
+            tuple(self.fanouts), self.dim, self.aggregator, self.concat
+        )
+
+    def __call__(self, hops):
+        hidden = [
+            jnp.concatenate(
+                [
+                    emb(ids, mask)
+                    for emb, (ids, mask) in zip(
+                        self.sparse_embeddings, hop
+                    )
+                ],
+                axis=-1,
+            )
+            for hop in hops
+        ]
+        return self.sage(hidden)
+
+
 class GCNEncoder(nn.Module):
     """Full-neighbor multi-hop GCN over padded COO adjacency
     (reference encoders.py:165-215)."""

@@ -259,7 +259,10 @@ def train(
         jax.profiler.stop_trace()
         (log_fn or log.info)(f"profiler trace written to {profile_dir}")
     if ckpt:
-        if steps_done % checkpoint_every != 0:
+        # final save only when NEW steps ran: a re-launch that resumed at
+        # num_steps (nothing left to train) must not re-save the step it
+        # restored — orbax raises StepAlreadyExistsError on the collision
+        if steps_done > start_step and steps_done % checkpoint_every != 0:
             ckpt.save(steps_done, state, force=True)
         ckpt.close()
     return state, history

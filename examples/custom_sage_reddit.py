@@ -12,6 +12,13 @@ the model zoo. The same recipe here, the euler_tpu way: the model is a
   _CustomSage(nn.Module): per-layer mean aggregation + softmax loss
                         (pure JAX, one XLA program)
 
+For graphs with SPARSE id features instead of dense vectors, swap the
+encoder for euler_tpu.nn.SparseSageEncoder (reference
+encoders.py:522-560): host-side, gather per-hop padded sparse ids with
+graph.get_sparse_feature; device-side the encoder embeds each slot
+(16-dim, concatenated) and Sage-aggregates — same fanout/hop layout as
+here.
+
     PYTHONPATH=. python examples/custom_sage_reddit.py [--steps 2000]
 """
 

@@ -1,0 +1,202 @@
+"""On-chip batch-scaling sweep for the device-sampling train step.
+
+PERF.md establishes that the step at reference-recipe dims is
+LATENCY-bound (~0.13 ms empty-scan floor, MFU ~1%). This sweep measures
+the complement: where the batch-size curve leaves the latency corner
+and what MFU/HBM utilization the design reaches when allowed to batch
+up — the throughput-optimal operating point (the reference's recipes
+fix batch at 512/1000 because its host sampler is the bottleneck;
+reference examples/sage.py:80-98, sage_reddit.py:80-97 — on TPU the
+sampler is on-device, so the operating point is free to move).
+
+One JSON line per (config, batch) point with step wall ms, edges/s, and
+the XLA cost-model roofline (MFU / HBM util) — appended to
+.bench_bank/sweep.jsonl the moment each point completes (same banking
+discipline as bench.py: a relay wedge mid-sweep keeps every completed
+point). Each config runs in a killable subprocess; the parent never
+touches the backend.
+
+    python scripts/batch_sweep.py [--configs ppi,reddit]
+        [--batches 512,2048,8192,32768]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_lib", os.path.join(_REPO, "bench.py")
+)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def sweep_config(name: str, batches, out_path: str) -> None:
+    """All batch points for one config in this process (the graph build
+    and feature-table upload are shared across points; each point's line
+    is banked the moment it exists)."""
+    import jax
+
+    import euler_tpu
+    from euler_tpu import train as train_lib
+    from euler_tpu.datasets import build_synthetic
+    from euler_tpu.models import SupervisedGraphSage
+
+    cfg = bench.CONFIGS[name]
+    cache = os.environ.get(
+        "EULER_TPU_BENCH_CACHE", "/tmp/euler_tpu_bench"
+    ) + "_" + cfg.get("cache_as", name)
+    build_synthetic(
+        cache,
+        num_nodes=cfg["num_nodes"],
+        avg_degree=cfg["avg_degree"],
+        feature_dim=cfg["feature_dim"],
+        label_dim=cfg["label_dim"],
+        multilabel=cfg["multilabel"],
+    )
+    graph = euler_tpu.Graph(directory=cache)
+    platform = jax.devices()[0].platform
+    fanouts = list(cfg["fanouts"])
+    edges_per_root = fanouts[0] + fanouts[0] * (
+        fanouts[1] if len(fanouts) > 1 else 0
+    )
+    opt = train_lib.get_optimizer("adam", cfg["lr"])
+
+    for batch in batches:
+        point = {"config": name, "batch": int(batch),
+                 "fanouts": fanouts, "dim": cfg["dim"],
+                 "platform": platform}
+        try:
+            model = SupervisedGraphSage(
+                label_idx=0,
+                label_dim=cfg["label_dim"],
+                metapath=[[0]] * len(fanouts),
+                fanouts=fanouts,
+                dim=cfg["dim"],
+                feature_idx=1,
+                feature_dim=cfg["feature_dim"],
+                max_id=cfg["num_nodes"] - 1,
+                device_features=True,
+                device_sampling=True,
+                feature_dtype=cfg.get("feature_dtype"),
+            )
+            state = model.init_state(
+                jax.random.PRNGKey(0), graph,
+                graph.sample_node(batch, -1), opt,
+            )
+            chunk_steps = 50
+            scan = jax.jit(
+                train_lib.make_scan_train(model, opt, chunk_steps, batch),
+                donate_argnums=(0,),
+            )
+            state, l0 = scan(state, 0)  # compile + warmup
+            jax.block_until_ready(l0)
+            chunks = 2 if platform == "cpu" else 6
+            t0 = time.perf_counter()
+            last = None
+            for c in range(1, chunks + 1):
+                state, last = scan(state, c)
+            jax.block_until_ready(last)
+            dt = time.perf_counter() - t0
+            step_ms = dt / (chunks * chunk_steps) * 1e3
+            bogus = bench._implausible(step_ms, last)
+            if bogus:
+                point["error"] = f"measurement rejected: {bogus}"
+            else:
+                sps = chunks * chunk_steps / dt
+                point["step_wall_ms"] = round(step_ms, 4)
+                point["steps_per_sec"] = round(sps, 2)
+                point["edges_per_sec"] = round(edges_per_root * batch * sps, 1)
+                point["final_loss"] = round(
+                    float(np.asarray(last)[-1]), 4
+                )
+                try:
+                    point["roofline"] = bench._roofline(
+                        scan.lower(state, 0).compile(), step_ms
+                    )
+                except Exception:
+                    pass
+            del state
+        except Exception as e:  # noqa: BLE001 — bank the failure, move on
+            point["error"] = f"{type(e).__name__}: {e}"[:300]
+        with open(out_path, "a") as f:
+            f.write(json.dumps(point) + "\n")
+        print(json.dumps(point), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--configs", default="ppi,reddit")
+    ap.add_argument("--batches", default="512,2048,8192,32768")
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, ".bench_bank", "sweep.jsonl"
+    ))
+    ap.add_argument("--run-one", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--deadline", type=float, default=900.0,
+                    help="per-config subprocess deadline (s); x3 on CPU")
+    args = ap.parse_args()
+    batches = [int(b) for b in args.batches.split(",") if b.strip()]
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+
+    if args.run_one:
+        if args.platform == "cpu":
+            from euler_tpu.parallel import force_cpu_devices
+
+            force_cpu_devices(1)
+        else:
+            from euler_tpu.parallel import honor_jax_platforms_env
+
+            honor_jax_platforms_env()
+        sweep_config(args.run_one, batches, args.out)
+        return
+
+    import signal
+    import subprocess
+
+    tpu_possible = os.environ.get(
+        "JAX_PLATFORMS", ""
+    ).split(",")[0].strip() in ("", "axon", "tpu")
+    platform, err = (None, "JAX_PLATFORMS pins a non-TPU backend")
+    if tpu_possible:
+        platform, err = bench.probe_backend(3, 150.0, 20.0)
+    child_platform = None if platform in ("tpu", "axon") else "cpu"
+    if child_platform == "cpu":
+        print(json.dumps({"note": f"CPU fallback: {err}"}), file=sys.stderr)
+    deadline = args.deadline * (3.0 if child_platform == "cpu" else 1.0)
+    for name in [n.strip() for n in args.configs.split(",") if n.strip()]:
+        cmd = [
+            sys.executable, "-u", os.path.abspath(__file__),
+            "--run-one", name, "--batches", args.batches,
+            "--out", args.out,
+        ]
+        if child_platform:
+            cmd += ["--platform", child_platform]
+        proc = subprocess.Popen(cmd, start_new_session=True)
+        try:
+            proc.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            proc.wait()
+            print(json.dumps({
+                "config": name,
+                "error": f"sweep subprocess killed at {deadline:.0f}s "
+                "(relay wedge?); completed points are banked",
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

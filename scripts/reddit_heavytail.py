@@ -39,6 +39,7 @@ import argparse
 import json
 import os
 import resource
+import shutil
 import sys
 import tempfile
 import time
@@ -194,9 +195,15 @@ def walk_study(
 
     n, e = num_nodes, num_edges
     d = tempfile.mkdtemp(prefix="walk_study_")
-    build_powerlaw(d, num_nodes=n, num_edges=e, feature_dim=4,
-                   label_dim=3, alpha=1.6, seed=seed)
-    g = euler_tpu.Graph(directory=d)
+    try:
+        build_powerlaw(d, num_nodes=n, num_edges=e, feature_dim=4,
+                       label_dim=3, alpha=1.6, seed=seed)
+        g = euler_tpu.Graph(directory=d)
+    finally:
+        # the native load copies the .dat bytes into the store (no
+        # mmap), so the multi-MB workdir can go the moment the graph is
+        # up — repeated invocations (incl. tests) must not litter /tmp
+        shutil.rmtree(d, ignore_errors=True)
     full_nbr, full_w, _, cnt = g.get_full_neighbor(np.arange(n), [0])
     rows = []          # per-node (ids, weights) from the host engine
     off = 0
@@ -212,10 +219,13 @@ def walk_study(
     }
 
     def exact_dist(x_set, x_id, v, p, q):
+        # adjacency beats the parent match (a parent self-loop is
+        # d_tx=1): the reference merge's equality branch runs before
+        # its candidate<parent check (euler/client/graph.cc:126-140)
         ids, w = rows[v]
         scale = np.where(
-            ids == x_id, 1.0 / p,
-            np.where(np.isin(ids, x_set), 1.0, 1.0 / q),
+            np.isin(ids, x_set), 1.0,
+            np.where(ids == x_id, 1.0 / p, 1.0 / q),
         )
         pr = w * scale
         return ids, pr / pr.sum()
@@ -255,7 +265,7 @@ def walk_study(
                     kept_x[np.clip(pos, 0, deg[x] - 1)] == kv
                 )
                 sc = np.where(
-                    kv == x, 1.0 / p, np.where(in_x, 1.0, 1.0 / q)
+                    in_x, 1.0, np.where(kv == x, 1.0 / p, 1.0 / q)
                 )
                 pr_t = wv * sc
                 pr_t = pr_t / pr_t.sum()
@@ -295,7 +305,79 @@ def walk_study(
         else:
             entry["note"] = "no valid (hub parent, sampleable v) pairs"
         out["caps"][f"W{W}"] = entry
+
+    # The exact device alternative: alias + rejection
+    # (device.alias_biased_random_walk). Empirical — the sampler is
+    # stochastic, so its TVD floor is sampling noise ~0.4*sqrt(S/K) for
+    # support size S — on the SAME affected step class (hub parent).
+    out["alias_rejection"] = _alias_rejection_study(
+        g, rows, cnt, seed=seed, pairs=min(pairs_per_cap, 40),
+    )
     return out
+
+
+def _alias_rejection_study(g, rows, cnt, seed: int, pairs: int,
+                           draws: int = 20000) -> dict:
+    """Empirical TVD of the exact alias+rejection biased step vs the
+    analytic node2vec distribution, over hub-parent steps (the class the
+    truncated slab distorts at mean TVD ~0.35). Expected: TVD at the
+    sampling-noise floor for `draws` draws."""
+    import jax
+    from euler_tpu.graph import device as dg
+
+    n = len(rows)
+    adj = dg.build_alias_adjacency(g, [0], n - 1, sorted=True)
+    rng = np.random.default_rng(seed + 1)
+    hubs = np.flatnonzero(cnt >= np.quantile(cnt[cnt > 0], 0.99))
+    if len(hubs) == 0:
+        return {"note": "no hub rows"}
+    tvds = []
+    T = dg.DEFAULT_WALK_TRIALS
+    for p, q in ((0.25, 4.0), (4.0, 0.25)):
+        step = jax.jit(
+            lambda cur, par, key, p=p, q=q: dg._alias_biased_step(
+                adj, cur, par, key, p, q, T
+            )
+        )
+        for i in range(pairs):
+            x = int(rng.choice(hubs))
+            x_full, _ = rows[x]
+            if len(x_full) == 0:
+                continue
+            v = int(rng.choice(x_full))
+            ids, w = rows[v]
+            if len(ids) == 0 or w.sum() <= 0:
+                continue
+            # analytic target with the reference's branch order
+            scale = np.where(
+                np.isin(ids, x_full), 1.0,
+                np.where(ids == x, 1.0 / p, 1.0 / q),
+            )
+            pr = w * scale
+            pr = pr / pr.sum()
+            cur = np.full(draws, v, np.int32)
+            par = np.full(draws, x, np.int32)
+            got = np.asarray(
+                step(cur, par, jax.random.PRNGKey(seed * 1000 + i))
+            )
+            uy, uc = np.unique(got, return_counts=True)
+            emp = {int(a): b / draws for a, b in zip(uy, uc)}
+            support = {int(y) for y in ids}
+            tvd = 0.5 * (
+                sum(abs(emp.get(int(y), 0.0) - pe)
+                    for y, pe in zip(ids, pr))
+                + sum(pv for y, pv in emp.items() if y not in support)
+            )
+            tvds.append(tvd)
+    if not tvds:
+        return {"note": "no valid pairs"}
+    return {
+        "mean_tvd": round(float(np.mean(tvds)), 4),
+        "max_tvd": round(float(np.max(tvds)), 4),
+        "pairs": len(tvds),
+        "draws_per_pair": draws,
+        "trials": T,
+    }
 
 
 def truncation_study(steps: int, batch: int) -> dict:
@@ -311,12 +393,15 @@ def truncation_study(steps: int, batch: int) -> dict:
 
     n, k_comm, fdim = 6000, 4, 16
     d = tempfile.mkdtemp(prefix="trunc_study_")
-    out_dir, info = build_planted(
-        d, num_nodes=n, num_communities=k_comm, feature_dim=fdim,
-        avg_degree=60, max_degree=1500, alpha=1.6, noise=1.2,
-        num_partitions=2, seed=29,
-    )
-    g = euler_tpu.Graph(directory=out_dir)
+    try:
+        out_dir, info = build_planted(
+            d, num_nodes=n, num_communities=k_comm, feature_dim=fdim,
+            avg_degree=60, max_degree=1500, alpha=1.6, noise=1.2,
+            num_partitions=2, seed=29,
+        )
+        g = euler_tpu.Graph(directory=out_dir)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)  # store holds a copy
     counts = g.get_full_neighbor(np.arange(n), [0])[3]
     summary: dict = {
         "graph": {

@@ -40,8 +40,8 @@ fi
 # builder, so this gate cannot drift from what _cache_begin would
 # accept (a bare done-marker check would wave through a stale cache
 # and trigger the full rebuild mid-window).
-CFGS="reddit,ppi"
-BENCH_BASE=2400
+CFGS="reddit,reddit_bf16,ppi"
+BENCH_BASE=3000
 if python -c "
 import sys
 from euler_tpu.datasets import (
@@ -57,7 +57,7 @@ sys.exit(
   # --deadline flag (unlike the EULER_TPU_BENCH_DEADLINE env var, which
   # is honored as-is) keeps bench.py's x3 CPU-fallback scaling, so a
   # slow-but-healthy CPU run is not misreported as a backend hang
-  BENCH_BASE=3600
+  BENCH_BASE=4800
 fi
 
 # bench.py runs every config in its own killable subprocess and banks
@@ -78,5 +78,14 @@ fi
 bench_rc=$?
 if [ "$bench_rc" -eq 124 ] || [ "$bench_rc" -eq 137 ]; then
   echo "tpu_checks: BENCH external deadline hit — backend wedged in a GIL-holding native call" >&2
+fi
+
+# Optional batch-scaling sweep (EULER_TPU_SWEEP=1): the throughput-
+# optimal operating point for PERF.md's batch/MFU curve. Per-point
+# results bank to .bench_bank/sweep.jsonl as they complete; failures
+# never mask the bench exit code.
+if [ "$EULER_TPU_SWEEP" = "1" ]; then
+  timeout -k 30 4000 python -u scripts/batch_sweep.py || \
+    echo "tpu_checks: sweep step failed (bench rc preserved)" >&2
 fi
 exit "$bench_rc"

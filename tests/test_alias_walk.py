@@ -1,0 +1,274 @@
+"""Exact device-side biased (p/q) walks via alias + rejection sampling
+(device.alias_biased_random_walk) — the heavy-tail replacement for the
+truncated-slab walk, restoring the reference's exact node2vec semantics
+(reference euler/client/graph.cc:120-151 BuildWeights over FULL neighbor
+lists) on graphs where the sorted slab must truncate.
+
+Covers: the rejection step's distribution matches the analytic d_tx
+target at sampling-noise TVD (where the truncated slab sits at ~0.35);
+walk mechanics (shapes, dead ends, step-0 semantics); and the enforced
+truncation guard — Node2Vec with device sampling on a truncated sorted
+slab must NOT silently use the distorted route.
+"""
+
+import os
+import shutil
+import tempfile
+import warnings
+
+import numpy as np
+import pytest
+
+import euler_tpu
+from euler_tpu.graph import device as dg
+
+
+@pytest.fixture(scope="module")
+def powerlaw_graph():
+    """Small heavy-tail graph + host-side full rows (the exactness
+    oracle). The workdir goes the moment the graph is up (the native
+    load copies the bytes; /tmp must not accumulate graph dirs)."""
+    from euler_tpu.datasets import build_powerlaw
+
+    d = tempfile.mkdtemp(prefix="alias_walk_")
+    try:
+        n, e = 800, 24_000
+        build_powerlaw(d, num_nodes=n, num_edges=e, feature_dim=4,
+                       label_dim=3, alpha=1.6, seed=5)
+        g = euler_tpu.Graph(directory=d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    nbr, w, _, cnt = g.get_full_neighbor(np.arange(n), [0])
+    rows, off = [], 0
+    for c in cnt:
+        rows.append((nbr[off:off + c], w[off:off + c]))
+        off += c
+    return g, rows, cnt, n
+
+
+def _exact_dist(rows, x, v, p, q):
+    """Analytic node2vec step distribution from v with parent x, the
+    reference's branch order (parent-adjacency beats the parent match on
+    self-loops, euler/client/graph.cc:126-140)."""
+    x_full = rows[x][0]
+    ids, w = rows[v]
+    scale = np.where(
+        np.isin(ids, x_full), 1.0,
+        np.where(ids == x, 1.0 / p, 1.0 / q),
+    )
+    pr = w * scale
+    return ids, pr / pr.sum()
+
+
+def _empirical_tvd(adj, rows, x, v, p, q, draws=40_000, trials=None):
+    import jax
+
+    ids, pr = _exact_dist(rows, x, v, p, q)
+    step = jax.jit(
+        lambda cur, par, key: dg._alias_biased_step(
+            adj, cur, par, key, p, q, trials or dg.DEFAULT_WALK_TRIALS
+        )
+    )
+    got = np.asarray(step(
+        np.full(draws, v, np.int32), np.full(draws, x, np.int32),
+        jax.random.PRNGKey(123),
+    ))
+    uy, uc = np.unique(got, return_counts=True)
+    emp = {int(a): b / draws for a, b in zip(uy, uc)}
+    support = {int(y) for y in ids}
+    return 0.5 * (
+        sum(abs(emp.get(int(y), 0.0) - pe) for y, pe in zip(ids, pr))
+        + sum(pv for y, pv in emp.items() if y not in support)
+    )
+
+
+def test_rejection_step_matches_exact_distribution(powerlaw_graph):
+    """Hub-parent steps — the class the truncated slab distorts at mean
+    TVD ~0.35 (PERF.md walk study) — must match the analytic target at
+    the sampling-noise floor on the rejection path."""
+    g, rows, cnt, n = powerlaw_graph
+    adj = dg.build_alias_adjacency(g, [0], n - 1, sorted=True)
+    rng = np.random.default_rng(7)
+    # >= : the top quantile can BE the max degree (ties at a cap)
+    hubs = np.flatnonzero(cnt >= np.quantile(cnt[cnt > 0], 0.99))
+    assert len(hubs) > 0
+    checked = 0
+    for p, q in ((0.25, 4.0), (4.0, 0.25), (0.5, 2.0)):
+        x = int(rng.choice(hubs))
+        x_full = rows[x][0]
+        v = int(rng.choice(x_full))
+        if len(rows[v][0]) == 0:
+            continue
+        tvd = _empirical_tvd(adj, rows, x, v, p, q)
+        # noise floor for S<=a few hundred support at 40k draws is
+        # ~0.02-0.04; the truncated slab sits an order of magnitude
+        # above this on the same step class
+        assert tvd < 0.06, f"p={p} q={q}: TVD {tvd:.3f}"
+        checked += 1
+    assert checked >= 2
+
+
+def test_rejection_step_self_loop_precedence(powerlaw_graph):
+    """A candidate that IS the parent while the parent has a self-loop
+    classifies d_tx=1 (weight w), matching the reference merge's branch
+    order — exercised on a purpose-built tiny graph fixture."""
+    # the shared fixture has no self-loops; build a 4-node graph with
+    # one: 0 -> {0, 1, 2}, 1 -> {0, 2}, 2 -> {0}, 3 isolated
+    d = tempfile.mkdtemp(prefix="selfloop_")
+    meta = {"node_type_num": 1, "edge_type_num": 1,
+            "node_uint64_feature_num": 0, "node_float_feature_num": 0,
+            "node_binary_feature_num": 0, "edge_uint64_feature_num": 0,
+            "edge_float_feature_num": 0, "edge_binary_feature_num": 0}
+    topo = {0: {0: 1.0, 1: 2.0, 2: 1.0}, 1: {0: 1.0, 2: 3.0},
+            2: {0: 1.0}, 3: {}}
+    nodes = [
+        {
+            "node_id": nid, "node_type": 0, "node_weight": 1.0,
+            "neighbor": {"0": {str(t): w for t, w in nbrs.items()}},
+            "uint64_feature": {}, "float_feature": {},
+            "binary_feature": {},
+            "edge": [
+                {"src_id": nid, "dst_id": t, "edge_type": 0,
+                 "weight": w, "uint64_feature": {},
+                 "float_feature": {}, "binary_feature": {}}
+                for t, w in nbrs.items()
+            ],
+        }
+        for nid, nbrs in topo.items()
+    ]
+    try:
+        euler_tpu.convert_dicts(
+            nodes, meta, os.path.join(d, "part"), num_partitions=1
+        )
+        g = euler_tpu.Graph(directory=d)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    nbr, w, _, cnt = g.get_full_neighbor(np.arange(4), [0])
+    rows, off = [], 0
+    for c in cnt:
+        rows.append((nbr[off:off + c], w[off:off + c]))
+        off += c
+    adj = dg.build_alias_adjacency(g, [0], 3, sorted=True)
+    # walk at node 0 with parent 0 (its own self-loop): candidate 0 is
+    # parent AND parent-adjacent -> d_tx=1 precedence (weight w, not
+    # w/p); p chosen to make the difference visible
+    p, q = 0.25, 4.0
+    tvd = _empirical_tvd(adj, rows, x=0, v=0, p=p, q=q, draws=30_000)
+    assert tvd < 0.03
+    # and the analytic target itself reflects the precedence: weight of
+    # the self-loop candidate is w (1.0), not w/p (4.0)
+    ids, pr = _exact_dist(rows, 0, 0, p, q)
+    i0 = int(np.flatnonzero(ids == 0)[0])
+    # all of 0's candidates {0,1,2} are neighbors of parent 0 -> all
+    # d_tx=1 -> target proportional to raw weights 1,2,1
+    np.testing.assert_allclose(pr, rows[0][1] / rows[0][1].sum())
+    assert pr[i0] == pytest.approx(0.25)
+    # HOST engine parity on the same fixture: 2-step walks from 0,
+    # conditioned on the self-loop step (c1 == 0) — the c2 distribution
+    # must match the same adjacency-first target
+    k = 40_000
+    paths = g.random_walk(np.zeros(k, np.int64), [0], 2, p, q, 4)
+    taken = paths[paths[:, 1] == 0]
+    assert len(taken) > 3000  # self-loop has weight 1/4 of node 0's row
+    c2, counts = np.unique(taken[:, 2], return_counts=True)
+    emp = {int(a): b / len(taken) for a, b in zip(c2, counts)}
+    host_tvd = 0.5 * sum(
+        abs(emp.get(int(y), 0.0) - pe) for y, pe in zip(ids, pr)
+    )
+    assert host_tvd < 0.03, f"host self-loop precedence off: {host_tvd:.3f}"
+
+
+def test_alias_walk_mechanics(powerlaw_graph):
+    import jax
+
+    g, rows, cnt, n = powerlaw_graph
+    adj = dg.build_alias_adjacency(g, [0], n - 1, sorted=True)
+    roots = np.arange(16, dtype=np.int32)
+    paths = np.asarray(jax.jit(
+        lambda r, k: dg.alias_biased_random_walk(adj, r, k, 4, 0.5, 2.0)
+    )(roots, jax.random.PRNGKey(0)))
+    assert paths.shape == (16, 5)
+    assert (paths[:, 0] == roots).all()
+    # every transition is a real edge (or a dead-end default fill)
+    default = n  # max_id + 1
+    for b in range(16):
+        for t in range(4):
+            src, dst = int(paths[b, t]), int(paths[b, t + 1])
+            if src == default or dst == default:
+                continue
+            assert dst in set(rows[src][0].tolist())
+    # dead ends chain into the default row and stay there
+    dead = np.flatnonzero(cnt == 0)
+    if len(dead):
+        pd = np.asarray(dg.alias_biased_random_walk(
+            adj, np.asarray([dead[0]], np.int32),
+            jax.random.PRNGKey(1), 3, 0.25, 4.0,
+        ))
+        assert (pd[0, 1:] == default).all()
+
+
+def test_node2vec_truncation_guard(powerlaw_graph):
+    """VERDICT round-4 weakness: --device_sampling Node2Vec on a graph
+    whose sorted slab truncates silently sampled a distribution at mean
+    TVD 0.35. The guard must reroute the walk adjacency to the exact
+    alias form (warning), and the model must train through it."""
+    import jax
+
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import Node2Vec
+
+    g, rows, cnt, n = powerlaw_graph
+    model = Node2Vec(
+        node_type=-1, edge_type=[0], max_id=n - 1, dim=8,
+        walk_len=2, walk_p=0.25, walk_q=4.0, device_sampling=True,
+        device_features=True, use_id=True, feature_idx=-1,
+    )
+    model.set_sampling_options(max_degree=32)  # forces truncation
+    opt = train_lib.get_optimizer("adam", 0.01)
+    roots = g.sample_node(8, -1)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        state = model.init_state(jax.random.PRNGKey(0), g, roots, opt)
+    assert any("alias+rejection" in str(w.message) for w in rec), (
+        "truncation guard must warn loudly"
+    )
+    k = model.adj_key([0], sorted=True)
+    assert "off" in state["consts"]["adj"][k], (
+        "guard must switch the walk adjacency to the exact alias form"
+    )
+    step = jax.jit(model.make_train_step(opt))
+    batch = model.sample(g, roots)
+    state, loss, _ = step(state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_sampling_alias_option_builds_sorted_alias(powerlaw_graph):
+    """set_sampling_options(alias=True) + biased walks builds the
+    id-sorted alias form directly (no slab, no warning)."""
+    import jax
+
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import Node2Vec
+
+    g, rows, cnt, n = powerlaw_graph
+    model = Node2Vec(
+        node_type=-1, edge_type=[0], max_id=n - 1, dim=8,
+        walk_len=2, walk_p=2.0, walk_q=0.5, device_sampling=True,
+        device_features=True, use_id=True, feature_idx=-1,
+    )
+    model.set_sampling_options(alias=True)
+    opt = train_lib.get_optimizer("adam", 0.01)
+    roots = g.sample_node(8, -1)
+    state = model.init_state(jax.random.PRNGKey(0), g, roots, opt)
+    k = model.adj_key([0], sorted=True)
+    adj = state["consts"]["adj"][k]
+    assert "off" in adj
+    # sorted contract: every CSR row is id-sorted
+    offs, degs, nbrs = (np.asarray(adj[x]) for x in ("off", "deg", "nbr"))
+    for i in range(0, n, 97):
+        row = nbrs[offs[i]:offs[i] + degs[i]]
+        assert (np.diff(row) >= 0).all()
+    state, loss, _ = jax.jit(model.make_train_step(opt))(
+        state, model.sample(g, roots)
+    )
+    assert np.isfinite(float(loss))

@@ -601,8 +601,9 @@ def test_device_sampling_model_families(graph, family):
 def _analytic_biased_joint(adj, root, p, q):
     """Exact P(c1, c2) for a 2-step node2vec walk from `root`, computed
     with numpy from the slab arrays: step 1 plain weighted, step 2
-    reweighted by d_tx w.r.t. parent=root (1/p return, 1 shared
-    neighbor, 1/q otherwise) — reference graph.cc:120-151 semantics."""
+    reweighted by d_tx w.r.t. parent=root (1 shared neighbor — winning
+    over 1/p on a root self-loop, the reference merge's branch order;
+    1/p return; 1/q otherwise) — reference graph.cc:120-151 semantics."""
     nbr, cum, deg = (
         np.asarray(adj["nbr"]), np.asarray(adj["cum"]),
         np.asarray(adj["deg"]),
@@ -620,8 +621,8 @@ def _analytic_biased_joint(adj, root, p, q):
         cands, w2 = row_probs(int(c1))
         scale = np.array(
             [
-                1.0 / p if c == root
-                else (1.0 if c in root_nbrs else 1.0 / q)
+                1.0 if c in root_nbrs
+                else (1.0 / p if c == root else 1.0 / q)
                 for c in cands
             ]
         )

@@ -118,3 +118,36 @@ def test_auc_metric():
         jnp.array([1, 1, 0, 0]), jnp.array([0.1, 0.2, 0.8, 0.9])
     )
     assert metrics.auc_from_counts(counts) < 1e-6
+
+
+def test_sparse_sage_encoder_public():
+    """SparseSageEncoder is a first-class public encoder (reference
+    encoders.py:522-560): standalone towers own their embedding tables;
+    shared_embeddings ties tables across towers (LasGNN's pattern)."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.nn import SparseSageEncoder
+
+    fanouts, dim, fdims = (3, 2), 8, (11, 5)
+    enc = SparseSageEncoder(fanouts, dim, feature_dims=fdims)
+    B = 4
+    sizes = [B, B * 3, B * 3 * 2]
+    hops = [
+        [
+            (jnp.ones((n, 2), jnp.int32), jnp.ones((n, 2)))
+            for _ in fdims
+        ]
+        for n in sizes
+    ]
+    params = enc.init(jax.random.PRNGKey(0), hops)
+    out = enc.apply(params, hops)
+    assert out.shape == (B, dim)
+    assert jnp.isfinite(out).all()
+    # per-slot tables sized feature_dim + 2 at embedding_dim 16
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    emb_shapes = sorted(
+        tuple(x.shape) for p, x in flat
+        if any("sparse_embeddings" in str(k) for k in p)
+    )
+    assert emb_shapes == [(7, 16), (13, 16)]

@@ -33,6 +33,11 @@ def test_train_eval_save_cycle(fixture_dir, tmp_path):
     assert emb.shape == (17, 8)
     ids = np.loadtxt(os.path.join(ck, "id.txt"), dtype=np.int64)
     assert len(ids) == 17
+    # relaunching train against the finished checkpoint resumes at
+    # num_steps, trains 0 new steps, and must exit cleanly instead of
+    # re-saving the restored step (orbax StepAlreadyExistsError)
+    assert main(_args(fixture_dir, ck, "--model", "graphsage_supervised",
+                      "--mode", "train")) == 0
     # frozen saved-embedding classifier trains from the export (fresh
     # checkpoint dir; the embedding comes from the previous run's export)
     assert main(_args(fixture_dir, str(tmp_path / "ck_cls"),

@@ -33,3 +33,8 @@ def test_walk_distortion_shrinks_with_cap_but_stays_real():
     assert w32["mean_tvd"] > w256["mean_tvd"] > 0.05
     assert w32["mean_tvd"] > 0.4
     assert 0 < w256["mean_exact_mass_misclassified"] < 1
+    # the exact alias+rejection walk sits at the sampling-noise floor —
+    # an order of magnitude under every slab cap on the same step class
+    ar = out["alias_rejection"]
+    assert ar["mean_tvd"] < 0.08
+    assert ar["mean_tvd"] * 4 < w256["mean_tvd"]
