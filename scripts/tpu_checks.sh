@@ -3,7 +3,8 @@
 #  1. the TPU-gated Pallas kernel suite (distribution pinning vs the host
 #     engine, OOB clamp, wide-slab register-boundary draw, the chained
 #     two-hop kernel, both shard_map SPMD paths) plus the alias-sampler
-#     suite on the real backend
+#     suite and the exact rejection-walk suite (distribution vs the
+#     analytic node2vec target) on the real backend
 #  2. the benchmarks in ONE bench.py run: reddit + the ppi headline
 #     (device-sampling scan loop, kernel on/off A/B, prefetch-overlap
 #     breakdown, profiler trace), PLUS the real-degree heavy-tail
@@ -24,7 +25,7 @@ SUITE_DEADLINE=${EULER_TPU_SUITE_DEADLINE:-1200}
 
 EULER_TPU_TESTS_ON_TPU=1 timeout -k 30 "$SUITE_DEADLINE" \
   python -u -m pytest tests/test_pallas_sampling.py \
-  tests/test_alias_sampling.py -v
+  tests/test_alias_sampling.py tests/test_alias_walk.py -v
 suite_rc=$?
 # 124 = SIGTERM honored; 137 = the wedged-in-device-wait mode ignores
 # SIGTERM and eats the -k 30 SIGKILL instead — both are the deadline

@@ -272,3 +272,33 @@ def test_sampling_alias_option_builds_sorted_alias(powerlaw_graph):
         state, model.sample(g, roots)
     )
     assert np.isfinite(float(loss))
+
+
+def test_node2vec_scan_train(powerlaw_graph):
+    """Whole-chunk device training (make_scan_train) composes with the
+    rejection-sampled walk: roots drawn on device, walks + pairs +
+    negatives inside one lax.scan dispatch."""
+    import jax
+
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import Node2Vec
+
+    g, rows, cnt, n = powerlaw_graph
+    model = Node2Vec(
+        node_type=-1, edge_type=[0], max_id=n - 1, dim=8,
+        walk_len=2, walk_p=0.25, walk_q=4.0, device_sampling=True,
+        device_features=True, feature_idx=-1,
+    )
+    model.set_sampling_options(alias=True)
+    opt = train_lib.get_optimizer("adam", 0.01)
+    state = model.init_state(
+        jax.random.PRNGKey(0), g, g.sample_node(16, -1), opt
+    )
+    scan = jax.jit(
+        train_lib.make_scan_train(model, opt, 5, 16), donate_argnums=(0,)
+    )
+    state, l1 = scan(state, 1)
+    state, l2 = scan(state, 2)
+    l2 = np.asarray(jax.device_get(l2))
+    assert l2.shape == (5,)
+    assert np.isfinite(l2).all() and (l2 > 0).all()
