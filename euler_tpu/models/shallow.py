@@ -42,6 +42,9 @@ class _ShallowUnsupModule(nn.Module):
     # add_sampling_consts(sorted=True)).
     walk_p: float = 1.0
     walk_q: float = 1.0
+    # rejection-walk proposal budget (alias adjacencies only); 0 =
+    # device.DEFAULT_WALK_TRIALS
+    walk_trials: int = 0
 
     def setup(self):
         kw = dict(
@@ -100,6 +103,7 @@ class _ShallowUnsupModule(nn.Module):
                     paths = device_graph.alias_biased_random_walk(
                         adj, roots, k_walk, self.walk_len,
                         self.walk_p, self.walk_q,
+                        trials=self.walk_trials or None,
                     )
                 else:
                     paths = device_graph.biased_random_walk(
@@ -283,9 +287,15 @@ class Node2Vec(_ShallowUnsupervised):
         combiner: str = "add",
         xent_loss: bool = False,
         embedding_dim: int = 16,
+        walk_trials: int = 0,
         **kwargs,
     ):
         super().__init__(node_type, max_id, **kwargs)
+        if walk_trials < 0:
+            raise ValueError(
+                f"walk_trials must be >= 0 (0 = library default), got "
+                f"{walk_trials}"
+            )
         self.edge_type = list(edge_type)
         self.walk_len = walk_len
         self.walk_p = walk_p
@@ -320,6 +330,7 @@ class Node2Vec(_ShallowUnsupervised):
             and bool(self.sparse_feature_idx),
             walk_p=walk_p,
             walk_q=walk_q,
+            walk_trials=walk_trials,
         )
 
     def sample(self, graph, inputs) -> dict:

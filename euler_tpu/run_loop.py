@@ -90,6 +90,10 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--walk_len", type=int, default=5)
     p.add_argument("--walk_p", type=float, default=1.0)
     p.add_argument("--walk_q", type=float, default=1.0)
+    p.add_argument("--walk_trials", type=int, default=0, help=(
+        "rejection-walk proposal budget per biased step on the device "
+        "alias path (0 = library default); higher lowers the "
+        "exhaustion-fallback rate at extreme p/q"))
     p.add_argument("--left_win_size", type=int, default=5)
     p.add_argument("--right_win_size", type=int, default=5)
     p.add_argument("--fanouts", default="10,10")
@@ -396,6 +400,7 @@ def build_model(args, graph):
             left_win_size=args.left_win_size,
             right_win_size=args.right_win_size,
             device_sampling=args.device_sampling,
+            walk_trials=args.walk_trials,
         )
     if name in ("gcn", "gcn_supervised"):
         # Full-neighbor GCN needs per-hop dense caps for static shapes.

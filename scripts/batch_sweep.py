@@ -53,19 +53,48 @@ def sweep_config(name: str, batches, out_path: str) -> None:
     from euler_tpu.models import SupervisedGraphSage
 
     cfg = bench.CONFIGS[name]
-    cache = os.environ.get(
-        "EULER_TPU_BENCH_CACHE", "/tmp/euler_tpu_bench"
-    ) + "_" + cfg.get("cache_as", name)
-    build_synthetic(
-        cache,
-        num_nodes=cfg["num_nodes"],
-        avg_degree=cfg["avg_degree"],
-        feature_dim=cfg["feature_dim"],
-        label_dim=cfg["label_dim"],
-        multilabel=cfg["multilabel"],
-    )
-    graph = euler_tpu.Graph(directory=cache)
+    if cfg.get("powerlaw"):
+        # heavy-tail config sweeps only against a FINISHED cache (the
+        # ~2 GB build must not burn a chip window; same gate as
+        # tpu_checks)
+        from euler_tpu.datasets import (
+            REDDIT_HEAVYTAIL, heavytail_cache_dir, powerlaw_cache_ready,
+        )
+
+        cfg = {**cfg, **REDDIT_HEAVYTAIL}
+        cache = heavytail_cache_dir()
+        if not powerlaw_cache_ready(cache, **REDDIT_HEAVYTAIL):
+            line = {"config": name,
+                    "error": "heavytail cache absent/stale; build with "
+                    "scripts/reddit_heavytail.py --full first"}
+            with open(out_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+            print(json.dumps(line), flush=True)
+            return
+    else:
+        cache = os.environ.get(
+            "EULER_TPU_BENCH_CACHE", "/tmp/euler_tpu_bench"
+        ) + "_" + cfg.get("cache_as", name)
+        build_synthetic(
+            cache,
+            num_nodes=cfg["num_nodes"],
+            avg_degree=cfg["avg_degree"],
+            feature_dim=cfg["feature_dim"],
+            label_dim=cfg["label_dim"],
+            multilabel=cfg["multilabel"],
+        )
     platform = jax.devices()[0].platform
+    if cfg.get("powerlaw") and platform == "cpu":
+        # the 114M-edge graph at batch 32768 is a chip workload; on a
+        # CPU fallback it would grind until the deadline SIGKILL and
+        # bank a misleading "relay wedge?" error
+        line = {"config": name,
+                "note": "heavytail sweep skipped on CPU (TPU-only)"}
+        with open(out_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        print(json.dumps(line), flush=True)
+        return
+    graph = euler_tpu.Graph(directory=cache)
     fanouts = list(cfg["fanouts"])
     edges_per_root = fanouts[0] + fanouts[0] * (
         fanouts[1] if len(fanouts) > 1 else 0
@@ -90,6 +119,8 @@ def sweep_config(name: str, batches, out_path: str) -> None:
                 device_sampling=True,
                 feature_dtype=cfg.get("feature_dtype"),
             )
+            if cfg.get("alias_sampling"):
+                model.set_sampling_options(alias=True)
             state = model.init_state(
                 jax.random.PRNGKey(0), graph,
                 graph.sample_node(batch, -1), opt,

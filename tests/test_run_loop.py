@@ -102,3 +102,16 @@ def test_model_dispatch(name, graph):
     model = build_model(args, graph)
     batch = model.sample(graph, np.asarray(graph.sample_node(8, -1)))
     assert isinstance(batch, dict) and batch
+
+
+def test_walk_trials_cli(graph):
+    """--walk_trials is threaded to the Node2Vec module (the rejection
+    walk's per-step proposal budget on the device alias path)."""
+    args = define_flags().parse_args(
+        COMMON + ["--model", "node2vec", "--all_node_type", "-1",
+                  "--walk_p", "0.25", "--walk_q", "4.0",
+                  "--walk_trials", "16", "--device_sampling", "true",
+                  "--device_features", "true", "--feature_idx", "-1"]
+    )
+    model = build_model(args, graph)
+    assert model.module.walk_trials == 16
