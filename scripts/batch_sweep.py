@@ -52,8 +52,24 @@ def sweep_config(name: str, batches, out_path: str) -> None:
     from euler_tpu.datasets import build_synthetic
     from euler_tpu.models import SupervisedGraphSage
 
+    def _bank_line(line: dict) -> None:
+        with open(out_path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+        print(json.dumps(line), flush=True)
+
     cfg = bench.CONFIGS[name]
+    platform = jax.devices()[0].platform
     if cfg.get("powerlaw"):
+        if platform == "cpu":
+            # the 114M-edge graph at batch 32768 is a chip workload; on
+            # a CPU fallback it would grind until the deadline SIGKILL
+            # and bank a misleading "relay wedge?" error (checked BEFORE
+            # the cache gate: a TPU-less machine must not be told to
+            # build a ~2 GB cache for a sweep it would skip anyway)
+            _bank_line({"config": name,
+                        "note": "heavytail sweep skipped on CPU "
+                        "(TPU-only)"})
+            return
         # heavy-tail config sweeps only against a FINISHED cache (the
         # ~2 GB build must not burn a chip window; same gate as
         # tpu_checks)
@@ -64,12 +80,9 @@ def sweep_config(name: str, batches, out_path: str) -> None:
         cfg = {**cfg, **REDDIT_HEAVYTAIL}
         cache = heavytail_cache_dir()
         if not powerlaw_cache_ready(cache, **REDDIT_HEAVYTAIL):
-            line = {"config": name,
-                    "error": "heavytail cache absent/stale; build with "
-                    "scripts/reddit_heavytail.py --full first"}
-            with open(out_path, "a") as f:
-                f.write(json.dumps(line) + "\n")
-            print(json.dumps(line), flush=True)
+            _bank_line({"config": name,
+                        "error": "heavytail cache absent/stale; build "
+                        "with scripts/reddit_heavytail.py --full first"})
             return
     else:
         cache = os.environ.get(
@@ -83,17 +96,6 @@ def sweep_config(name: str, batches, out_path: str) -> None:
             label_dim=cfg["label_dim"],
             multilabel=cfg["multilabel"],
         )
-    platform = jax.devices()[0].platform
-    if cfg.get("powerlaw") and platform == "cpu":
-        # the 114M-edge graph at batch 32768 is a chip workload; on a
-        # CPU fallback it would grind until the deadline SIGKILL and
-        # bank a misleading "relay wedge?" error
-        line = {"config": name,
-                "note": "heavytail sweep skipped on CPU (TPU-only)"}
-        with open(out_path, "a") as f:
-            f.write(json.dumps(line) + "\n")
-        print(json.dumps(line), flush=True)
-        return
     graph = euler_tpu.Graph(directory=cache)
     fanouts = list(cfg["fanouts"])
     edges_per_root = fanouts[0] + fanouts[0] * (
@@ -174,8 +176,10 @@ def main() -> None:
     ))
     ap.add_argument("--run-one", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
-    ap.add_argument("--deadline", type=float, default=900.0,
-                    help="per-config subprocess deadline (s); x3 on CPU")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-config subprocess deadline (s); x3 on CPU. "
+                    "Default: per-config (900 s; reddit_heavytail 2400 s "
+                    "— one alias upload plus a compile per batch point)")
     args = ap.parse_args()
     batches = [int(b) for b in args.batches.split(",") if b.strip()]
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
@@ -204,8 +208,15 @@ def main() -> None:
     child_platform = None if platform in ("tpu", "axon") else "cpu"
     if child_platform == "cpu":
         print(json.dumps({"note": f"CPU fallback: {err}"}), file=sys.stderr)
-    deadline = args.deadline * (3.0 if child_platform == "cpu" else 1.0)
+    # the heavytail sweep does strictly more than bench's single point
+    # (same graph load + alias upload, then a compile per batch point)
+    caps = {"reddit_heavytail": 2400.0}
     for name in [n.strip() for n in args.configs.split(",") if n.strip()]:
+        deadline = (
+            args.deadline
+            if args.deadline is not None
+            else caps.get(name, 900.0)
+        ) * (3.0 if child_platform == "cpu" else 1.0)
         cmd = [
             sys.executable, "-u", os.path.abspath(__file__),
             "--run-one", name, "--batches", args.batches,
