@@ -659,20 +659,13 @@ def _run_one(name: str, bank_file: str, platform: str | None,
         from euler_tpu.parallel import honor_jax_platforms_env
 
         honor_jax_platforms_env()
-    # persistent XLA compile cache: chip windows are scarce, and a
-    # relaunched config (or the next round's run) reuses compiles
-    # instead of repaying 20-40 s each
-    import jax
+    # persistent XLA compile cache: a relaunched config (or the next
+    # round's run) reuses compiles instead of repaying 20-40 s each
+    from euler_tpu.parallel import enable_compile_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-            ),
-        ),
-    )
+    enable_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    ))
     try:
         result = run_config(
             name, CONFIGS[name], trace_dir,

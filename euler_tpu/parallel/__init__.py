@@ -1,5 +1,6 @@
 from euler_tpu.parallel.mesh import (
     batch_sharding,
+    enable_compile_cache,
     force_cpu_devices,
     honor_jax_platforms_env,
     probe_backend_once,
@@ -16,6 +17,7 @@ from euler_tpu.parallel.prefetch import prefetch
 
 __all__ = [
     "batch_sharding",
+    "enable_compile_cache",
     "force_cpu_devices",
     "honor_jax_platforms_env",
     "probe_backend_once",

@@ -114,6 +114,19 @@ def probe_backend_or_die(timeout_s: float | None = None) -> None:
     )
 
 
+def enable_compile_cache(default_dir: str | None = None) -> None:
+    """Point JAX's persistent compilation cache at
+    JAX_COMPILATION_CACHE_DIR (or ``default_dir``): chip windows are
+    scarce and each TPU program compile costs 20-40 s — a relaunched
+    config, the next checks step, or the next session reuses compiles.
+    Call before the first jit; no-op when neither location is given."""
+    import os
+
+    d = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
+    if d:
+        jax.config.update("jax_compilation_cache_dir", d)
+
+
 def force_cpu_devices(n_devices: int) -> None:
     """Force an n_devices-wide virtual CPU platform, overriding any ambient
     JAX_PLATFORMS / XLA_FLAGS (the environment here exports

@@ -162,9 +162,7 @@ def sweep_config(name: str, batches, out_path: str) -> None:
             del state
         except Exception as e:  # noqa: BLE001 — bank the failure, move on
             point["error"] = f"{type(e).__name__}: {e}"[:300]
-        with open(out_path, "a") as f:
-            f.write(json.dumps(point) + "\n")
-        print(json.dumps(point), flush=True)
+        _bank_line(point)
 
 
 def main() -> None:
@@ -193,6 +191,9 @@ def main() -> None:
             from euler_tpu.parallel import honor_jax_platforms_env
 
             honor_jax_platforms_env()
+        from euler_tpu.parallel import enable_compile_cache
+
+        enable_compile_cache(os.path.join(_REPO, ".jax_cache"))
         sweep_config(args.run_one, batches, args.out)
         return
 

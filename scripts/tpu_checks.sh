@@ -96,8 +96,10 @@ fi
 if [ "$EULER_TPU_SWEEP" = "1" ]; then
   # reddit_heavytail sweeps only when its cache is ready (the script
   # gates itself and records a skip line otherwise). External deadline
-  # covers the per-config caps (900 + 900 + 2400) with slack.
-  timeout -k 30 5000 python -u scripts/batch_sweep.py \
+  # covers the per-config caps in the WORST (CPU-fallback x3) case:
+  # 3x(900 + 900) for ppi+reddit plus the heavytail skip, with slack —
+  # a healthy TPU run (900 + 900 + 2400) finishes far earlier.
+  timeout -k 30 8400 python -u scripts/batch_sweep.py \
     --configs ppi,reddit,reddit_heavytail || \
     echo "tpu_checks: sweep step failed (bench rc preserved)" >&2
 fi

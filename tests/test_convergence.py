@@ -254,8 +254,12 @@ elif family.startswith("node2vec_biased"):
     m = models.Node2Vec(
         node_type=-1, edge_type=[0], max_id=n_nodes - 1, dim=32,
         walk_len=3, walk_p=0.5, walk_q=2.0, num_negs=5,
-        device_sampling=family.endswith("_device"),
+        device_sampling=family != "node2vec_biased",
     )
+    if family.endswith("_alias"):
+        # round-5 exact rejection-sampled walk over flat-CSR alias
+        # tables — must learn the same structure as the slab walk
+        m.set_sampling_options(alias=True)
 else:
     m = models.GraphSage(
         node_type=-1, edge_type=[0], max_id=n_nodes - 1,
@@ -279,6 +283,7 @@ print("MRR", hist[-1]["mrr"], flush=True)
         ("line2", 0.7),
         ("node2vec_biased", 0.9),
         ("node2vec_biased_device", 0.9),
+        ("node2vec_biased_alias", 0.9),
         ("unsup_sage", 0.55),
     ],
 )
