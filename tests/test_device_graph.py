@@ -886,4 +886,13 @@ def test_remote_graph_export_matches_local(graph, tmp_path):
         for k in ("nbr", "deg", "sampleable"):
             np.testing.assert_array_equal(ra[k], la[k])
         np.testing.assert_allclose(ra["cum"], la["cum"], rtol=1e-6)
+        # the exact alias form (incl. the id-sorted rows the rejection
+        # walk bisects) exports identically through the sharded client
+        raa = device.build_alias_adjacency(remote, [0, 1], MAX_ID,
+                                           sorted=True)
+        laa = device.build_alias_adjacency(graph, [0, 1], MAX_ID,
+                                           sorted=True)
+        for k in ("off", "deg", "nbr", "alias", "sampleable"):
+            np.testing.assert_array_equal(raa[k], laa[k])
+        np.testing.assert_allclose(raa["prob"], laa["prob"], rtol=1e-6)
         remote.close()
