@@ -205,7 +205,11 @@ def build_alias_adjacency(
     Returns {"off": [N+2] int32 row starts, "deg": [N+2] int32,
     "nbr": [E] int32, "alias": [E] int32 (GLOBAL ids, prebaked so the
     draw needs no second row-local hop), "prob": [E] float32,
-    "sampleable": [N+2] bool} with N = max_id + 1 and E = total edges.
+    "sampleable": [N+2] bool, "bisect_steps": [ceil(log2(max_degree))]
+    int8 zeros — a SHAPE-carried static (array shapes survive jit
+    tracing where an int leaf would be traced) that lets the rejection
+    walk's membership bisection stop at the max ROW width instead of
+    log2(E) iterations} with N = max_id + 1 and E = total edges.
     Memory is O(E) — 12 bytes/edge vs the slab's O(N * max_degree) —
     e.g. ~1.4 GB for a 114M-edge Reddit-scale graph. The alias build
     itself runs in native code (eg_build_alias_csr, OpenMP over rows).
@@ -258,6 +262,7 @@ def build_alias_adjacency(
     sums = csum_z[offsets[1:]] - csum_z[offsets[:-1]]
     sampleable = (counts_all > 0) & (sums > 0)
     sampleable[default] = False
+    max_deg = int(counts_all.max()) if len(counts_all) else 0
     return {
         "off": offsets[:-1].astype(np.int32),
         "deg": counts_all.astype(np.int32),
@@ -265,6 +270,7 @@ def build_alias_adjacency(
         "alias": alias_ids,
         "prob": prob,
         "sampleable": sampleable,
+        "bisect_steps": np.zeros(max(max_deg.bit_length(), 1), np.int8),
     }
 
 
@@ -344,7 +350,10 @@ def _bisect_first_ge(cum, lo, hi, u, steps: int):
     M = max(int(cum.shape[0]), 1)
     for _ in range(steps):
         active = lo < hi
-        mid = (lo + hi) // 2
+        # lo + (hi - lo)//2, NOT (lo + hi)//2: int32 lo+hi wraps
+        # negative for rows near the end of a >2^30-entry table (a size
+        # build_alias_adjacency permits), silently corrupting the search
+        mid = lo + (hi - lo) // 2
         go_right = cum[jnp.clip(mid, 0, M - 1)] < u
         lo = jnp.where(active & go_right, mid + 1, lo)
         hi = jnp.where(active & ~go_right, mid, hi)
@@ -667,7 +676,15 @@ def _alias_biased_step(adj, cur, parent, key, p: float, q: float,
     phi = jnp.broadcast_to(
         (offs[parent] + degs[parent])[:, None], (b, trials)
     )
-    pos = _bisect_first_ge(nbrs, plo, phi, cand, max(e.bit_length(), 1))
+    # bisection depth: the max ROW width bound when the builder recorded
+    # it (shape-carried static — log2(58k)=16 vs log2(114M)=27 on the
+    # heavy-tail flagship), else the always-safe log2(E)
+    steps = (
+        int(adj["bisect_steps"].shape[0])
+        if "bisect_steps" in adj
+        else max(e.bit_length(), 1)
+    )
+    pos = _bisect_first_ge(nbrs, plo, phi, cand, steps)
     hit = (nbrs[jnp.clip(pos, 0, e - 1)] == cand) & (pos < phi)
     is_par = cand == parent[:, None]
     s = jnp.where(hit, 1.0, jnp.where(is_par, 1.0 / p, 1.0 / q))
