@@ -1,57 +1,58 @@
 #!/bin/sh
-# Everything that needs the real chip, in dependency order:
-#  1. the TPU-gated Pallas kernel suite (distribution pinning vs the host
-#     engine, OOB clamp, wide-slab register-boundary draw, the chained
-#     two-hop kernel, both shard_map SPMD paths) plus the alias-sampler
-#     suite and the exact rejection-walk suite (distribution vs the
-#     analytic node2vec target) on the real backend
-#  2. the benchmarks in ONE bench.py run: reddit + the ppi headline
-#     (device-sampling scan loop, kernel on/off A/B, prefetch-overlap
-#     breakdown, profiler trace), PLUS the real-degree heavy-tail
-#     config (113.7M-edge power-law, exact alias device sampling) when
-#     its ~2 GB graph cache is already built WITH current params
-#     (scripts/reddit_heavytail.py --full builds it; a stale or absent
-#     cache skips the config rather than paying the rebuild on a chip
-#     window).
-# CPU-only environments: the kernel suite skips itself; bench falls back
-# with an "error" field. Safe to run unattended: every step has a hard
-# deadline and unbuffered output — the relay has been observed to wedge
-# AFTER a successful probe (2026-07-31: pytest blocked 19 min in backend
-# init with zero CPU accumulation), and a silent hang must surface as a
-# visible timeout, not eat the session. Exit code 124 from a step means
-# the deadline hit (relay wedged mid-run).
+# Everything that needs the real chip, in EVIDENCE-VALUE order — the
+# relay can wedge at any moment mid-window, so the scarcest artifacts
+# run first:
+#  1. the benchmarks in ONE bench.py run: reddit + the bf16 A/B + the
+#     ppi headline (device-sampling scan loop, kernel on/off A/B,
+#     prefetch-overlap breakdown, profiler trace), PLUS the real-degree
+#     heavy-tail config (113.7M-edge power-law, exact alias device
+#     sampling) when its ~2 GB graph cache is already built WITH
+#     current params (scripts/reddit_heavytail.py --full builds it; a
+#     stale or absent cache skips the config rather than paying the
+#     rebuild on a chip window). Every config runs in its own killable
+#     subprocess banking its JSON to .bench_bank/ the moment it exists,
+#     so a wedge costs one config, not the window.
+#  2. the TPU-gated Pallas kernel suite (distribution pinning vs the
+#     host engine, OOB clamp, wide-slab register-boundary draw, the
+#     chained two-hop kernel, both shard_map SPMD paths) plus the
+#     alias-sampler suite and the exact rejection-walk suite
+#     (distribution vs the analytic node2vec target) on the real
+#     backend.
+#  3. (EULER_TPU_SWEEP=1) the batch-scaling sweep.
+# CPU-only environments: bench falls back with an "error" field; the
+# kernel suite skips itself. Safe to run unattended: every step has a
+# hard deadline and unbuffered output — the relay has been observed to
+# wedge AFTER a successful probe (2026-07-31: pytest blocked 19 min in
+# backend init with zero CPU accumulation), and a silent hang must
+# surface as a visible timeout, not eat the session. Exit code 124
+# from a step means the deadline hit (relay wedged mid-run). A suite
+# failure no longer aborts the later steps: each step self-protects,
+# and on a flaky relay "some banked evidence" beats "clean abort".
 cd "$(dirname "$0")/.." || exit 1
 SUITE_DEADLINE=${EULER_TPU_SUITE_DEADLINE:-1200}
 
 # Persistent XLA compilation cache: chip windows are scarce and the
 # first TPU compile of each program costs 20-40 s — a second window
-# (or the bench after the suite) reuses compiles instead of repaying
+# (or the suite after the bench) reuses compiles instead of repaying
 # them. Harmless on CPU fallback.
 JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-"$(pwd)/.jax_cache"}
 export JAX_COMPILATION_CACHE_DIR
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-2}
 
-EULER_TPU_TESTS_ON_TPU=1 timeout -k 30 "$SUITE_DEADLINE" \
-  python -u -m pytest tests/test_pallas_sampling.py \
-  tests/test_alias_sampling.py tests/test_alias_walk.py -v
-suite_rc=$?
-# 124 = SIGTERM honored; 137 = the wedged-in-device-wait mode ignores
-# SIGTERM and eats the -k 30 SIGKILL instead — both are the deadline
-if [ "$suite_rc" -eq 124 ] || [ "$suite_rc" -eq 137 ]; then
-  echo "tpu_checks: SUITE DEADLINE (${SUITE_DEADLINE}s) hit — relay wedged mid-run" >&2
-fi
-[ "$suite_rc" -eq 0 ] || exit "$suite_rc"
-
-# One bench.py invocation for every config (a second process would pay
-# the backend probe cycle twice on the scarce window). The heavytail
-# config joins only when its cache is FINISHED with CURRENT params —
-# datasets.powerlaw_cache_ready shares the params constructor with the
-# builder, so this gate cannot drift from what _cache_begin would
-# accept (a bare done-marker check would wave through a stale cache
-# and trigger the full rebuild mid-window).
+# --- step 1: bench (one bench.py invocation for every config — a
+# second process would pay the backend probe cycle twice on the scarce
+# window). The heavytail config joins only when its cache is FINISHED
+# with CURRENT params — datasets.powerlaw_cache_ready shares the params
+# constructor with the builder, so this gate cannot drift from what
+# _cache_begin would accept (a bare done-marker check would wave
+# through a stale cache and trigger the full rebuild mid-window).
 CFGS="reddit,reddit_bf16,ppi"
 BENCH_BASE=3000
-if python -c "
+# the gate gets its own deadline like every other step (a site hook
+# can pre-register a backend at interpreter start; a hang here would
+# otherwise silently eat the window before bench even begins) — a
+# timed-out gate counts as "cache not ready"
+if timeout -k 10 60 python -c "
 import sys
 from euler_tpu.datasets import (
     REDDIT_HEAVYTAIL, heavytail_cache_dir, powerlaw_cache_ready,
@@ -62,7 +63,7 @@ sys.exit(
 )
 "; then
   CFGS="reddit_heavytail,$CFGS"
-  # three configs need headroom beyond the two-config default; the
+  # four configs need headroom beyond the three-config default; the
   # --deadline flag (unlike the EULER_TPU_BENCH_DEADLINE env var, which
   # is honored as-is) keeps bench.py's x3 CPU-fallback scaling, so a
   # slow-but-healthy CPU run is not misreported as a backend hang
@@ -89,10 +90,21 @@ if [ "$bench_rc" -eq 124 ] || [ "$bench_rc" -eq 137 ]; then
   echo "tpu_checks: BENCH external deadline hit — backend wedged in a GIL-holding native call" >&2
 fi
 
-# Optional batch-scaling sweep (EULER_TPU_SWEEP=1): the throughput-
-# optimal operating point for PERF.md's batch/MFU curve. Per-point
-# results bank to .bench_bank/sweep.jsonl as they complete; failures
-# never mask the bench exit code.
+# --- step 2: the on-hardware suites
+EULER_TPU_TESTS_ON_TPU=1 timeout -k 30 "$SUITE_DEADLINE" \
+  python -u -m pytest tests/test_pallas_sampling.py \
+  tests/test_alias_sampling.py tests/test_alias_walk.py -v
+suite_rc=$?
+# 124 = SIGTERM honored; 137 = the wedged-in-device-wait mode ignores
+# SIGTERM and eats the -k 30 SIGKILL instead — both are the deadline
+if [ "$suite_rc" -eq 124 ] || [ "$suite_rc" -eq 137 ]; then
+  echo "tpu_checks: SUITE DEADLINE (${SUITE_DEADLINE}s) hit — relay wedged mid-run" >&2
+fi
+
+# --- step 3 (optional): batch-scaling sweep (EULER_TPU_SWEEP=1) — the
+# throughput-optimal operating point for PERF.md's batch/MFU curve.
+# Per-point results bank to .bench_bank/sweep.jsonl as they complete;
+# failures never mask the bench/suite exit code.
 if [ "$EULER_TPU_SWEEP" = "1" ]; then
   # reddit_heavytail sweeps only when its cache is ready (the script
   # gates itself and records a skip line otherwise). External deadline
@@ -101,6 +113,12 @@ if [ "$EULER_TPU_SWEEP" = "1" ]; then
   # a healthy TPU run (900 + 900 + 2400) finishes far earlier.
   timeout -k 30 8400 python -u scripts/batch_sweep.py \
     --configs ppi,reddit,reddit_heavytail || \
-    echo "tpu_checks: sweep step failed (bench rc preserved)" >&2
+    echo "tpu_checks: sweep step failed (bench/suite rc preserved)" >&2
 fi
-exit "$bench_rc"
+
+# bench is the scarce driver-facing artifact; its failure outranks the
+# suite's, but a green bench with a red suite still fails the script
+if [ "$bench_rc" -ne 0 ]; then
+  exit "$bench_rc"
+fi
+exit "$suite_rc"
