@@ -72,6 +72,27 @@ int eg_load_files(void* h, const char** files, int nfiles) {
   return 0;
 }
 
+// Streaming ingest: partition bytes fetched by the caller (e.g. off an
+// object store) parse straight into the store — no local staging file.
+// The buffers only need to live for the duration of this call.
+int eg_load_buffers(void* h, const void* const* bufs, const uint64_t* lens,
+                    const char* const* names, int n) {
+  auto* e = Local(h);
+  try {
+    std::vector<size_t> sz(n);
+    for (int i = 0; i < n; ++i) sz[i] = static_cast<size_t>(lens[i]);
+    if (!e->LoadBuffers(reinterpret_cast<const char* const*>(bufs),
+                        sz.data(), names, n)) {
+      g_last_error = e->error();
+      return -1;
+    }
+  } catch (const std::exception& ex) {
+    g_last_error = std::string("graph load failed: ") + ex.what();
+    return -1;
+  }
+  return 0;
+}
+
 void eg_seed(uint64_t seed) { eg::SeedThreadRng(seed); }
 
 // ---- remote mode (Graph::NewGraph(mode=Remote) equivalent,

@@ -4,6 +4,7 @@
 
 #include <algorithm>
 #include <cstdio>
+#include <cstring>
 #include <fstream>
 #include <thread>
 
@@ -62,46 +63,83 @@ bool Engine::Load(const std::string& dir, int shard_idx, int shard_num) {
   return LoadFiles(std::move(files));
 }
 
-bool Engine::LoadFiles(std::vector<std::string> files) {
-  std::sort(files.begin(), files.end());
-  // One staging per file so the merged order is deterministic regardless of
-  // thread scheduling (reference loads files across threads too,
+bool Engine::ParseStagings(
+    const std::vector<std::string>& labels,
+    const std::function<void(int, Staging*, std::string*)>& parse_one) {
+  // One staging per item so the merged order is deterministic regardless
+  // of thread scheduling (reference loads files across threads too,
   // euler/core/graph_builder.cc:91-120).
-  std::vector<Staging> parts(files.size());
-  std::vector<std::string> io_errors(files.size());
-  unsigned nthreads =
-      std::min<unsigned>(std::thread::hardware_concurrency(),
-                         static_cast<unsigned>(files.size()));
+  int n = static_cast<int>(labels.size());
+  std::vector<Staging> parts(n);
+  std::vector<std::string> errors(n);
+  unsigned nthreads = std::min<unsigned>(
+      std::thread::hardware_concurrency(), static_cast<unsigned>(n));
   nthreads = std::max(1u, nthreads);
   std::vector<std::thread> threads;
   for (unsigned w = 0; w < nthreads; ++w) {
     threads.emplace_back([&, w]() {
-      for (size_t i = w; i < files.size(); i += nthreads) {
+      for (int i = w; i < n; i += static_cast<int>(nthreads)) {
         try {
-          std::string data;
-          if (!ReadWholeFile(files[i], &data)) {
-            io_errors[i] = "cannot read " + files[i];
-            continue;
-          }
-          if (!parts[i].ParseFile(data.data(), data.size()) &&
-              parts[i].error.empty())
-            parts[i].error = "parse failure in " + files[i];
+          parse_one(i, &parts[i], &errors[i]);
         } catch (const std::exception& ex) {
           // an exception escaping a worker thread is std::terminate —
-          // surface it like any other per-file error instead
-          io_errors[i] = std::string("load of ") + files[i] +
-                         " threw: " + ex.what();
+          // surface it like any other per-item error instead
+          errors[i] = labels[i] + " threw: " + ex.what();
         }
       }
     });
   }
   for (auto& t : threads) t.join();
-  for (auto& e : io_errors)
+  for (auto& e : errors)
     if (!e.empty()) {
       error_ = e;
       return false;
     }
   return store_.Build(&parts, &error_);
+}
+
+bool Engine::LoadFiles(std::vector<std::string> files) {
+  std::sort(files.begin(), files.end());
+  // Bytes live only inside one worker iteration — only ~nthreads raw
+  // files are in memory at once (the property the streamed path trades
+  // away; see remote_fs.read_directory's RAM note).
+  return ParseStagings(
+      files, [&](int i, Staging* part, std::string* err) {
+        std::string data;
+        if (!ReadWholeFile(files[i], &data)) {
+          *err = "cannot read " + files[i];
+          return;
+        }
+        if (!part->ParseFile(data.data(), data.size()) &&
+            part->error.empty())
+          part->error = "parse failure in " + files[i];
+      });
+}
+
+bool Engine::LoadBuffers(const char* const* bufs, const size_t* lens,
+                         const char* const* names, int n) {
+  if (n <= 0) {
+    error_ = "no partition buffers";
+    return false;
+  }
+  // name-sorted merge order, like LoadFiles' sort of paths — the built
+  // store must not depend on the order the fetches completed in
+  std::vector<int> order(n);
+  for (int i = 0; i < n; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int a, int b) {
+    return std::strcmp(names[a], names[b]) < 0;
+  });
+  std::vector<std::string> labels(n);
+  for (int i = 0; i < n; ++i) labels[i] = names[order[i]];
+  return ParseStagings(
+      labels, [&](int i, Staging* part, std::string* err) {
+        int src = order[i];
+        if (!part->ParseFile(bufs[src], lens[src]))
+          // streamed buffers have no path in the Staging error —
+          // attribute the partition name here
+          *err = labels[i] + ": " +
+                 (part->error.empty() ? "parse failure" : part->error);
+      });
 }
 
 void Engine::SampleNode(int count, int32_t type, uint64_t* out) const {

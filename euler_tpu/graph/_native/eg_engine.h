@@ -13,6 +13,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <functional>
 #include <memory>
 #include <string>
 #include <vector>
@@ -39,6 +40,14 @@ class Engine : public GraphAPI {
   // a partition suffix belong to partition 0.
   bool Load(const std::string& dir, int shard_idx, int shard_num);
   bool LoadFiles(std::vector<std::string> files);
+  // Parse partition bytes already in memory — the streaming ingest path
+  // (remote bytes go fetch -> parse -> store with no local staging; the
+  // reference reads partitions straight off HDFS instead,
+  // euler/common/hdfs_file_io.cc:79-80). names[i] attributes parse
+  // errors; buffers are merged in name-sorted order so the store is
+  // byte-identical to LoadFiles on the same partitions.
+  bool LoadBuffers(const char* const* bufs, const size_t* lens,
+                   const char* const* names, int n);
   const std::string& error() const { return error_; }
 
   const GraphStore& store() const { return store_; }
@@ -137,6 +146,15 @@ class Engine : public GraphAPI {
                                  const int32_t* fids, int nf) const;
 
  private:
+  // One staging-parse fan-out shared by the file and buffer loaders
+  // (strided worker pool, per-slot error attribution, merged Build) —
+  // the two ingest modes must never diverge in threading or error
+  // semantics. labels[i] attributes exceptions; parse_one fills
+  // parts[i]/errors[i].
+  bool ParseStagings(
+      const std::vector<std::string>& labels,
+      const std::function<void(int, Staging*, std::string*)>& parse_one);
+
   GraphStore store_;
   std::string error_;
 };

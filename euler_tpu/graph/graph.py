@@ -113,6 +113,7 @@ class Graph:
         quarantine_ms: int | None = None,
         rediscover_ms: int | None = None,
         cache_dir: str | None = None,
+        stream: bool | None = None,
         config: str | None = None,
         init: str | None = None,
     ):
@@ -126,7 +127,7 @@ class Graph:
         known = {
             "directory", "files", "shard_idx", "shard_num", "mode",
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
-            "rediscover_ms", "cache_dir", "init",
+            "rediscover_ms", "cache_dir", "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -158,6 +159,9 @@ class Graph:
         # the native default (3000 ms with a registry, off for shards=)
         rediscover_ms = pick("rediscover_ms", rediscover_ms, None)
         cache_dir = pick("cache_dir", cache_dir, None)
+        stream = pick("stream", stream, False)
+        if isinstance(stream, str):
+            stream = stream.lower() in ("1", "true", "yes")
         init = str(pick("init", init, "eager")).lower()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
@@ -168,7 +172,7 @@ class Graph:
             shard_num=shard_num, registry=registry, shards=shards,
             retries=retries, timeout_ms=timeout_ms,
             quarantine_ms=quarantine_ms, rediscover_ms=rediscover_ms,
-            cache_dir=cache_dir,
+            cache_dir=cache_dir, stream=bool(stream),
         )
         self.mode = mode
         if init == "eager":
@@ -206,23 +210,43 @@ class Graph:
         # fast local path (see euler_tpu/graph/remote_fs.py).
         from euler_tpu.graph import remote_fs
 
+        buffers = None
         if mode == "local":
             # directory=/files= are only consumed by the embedded engine;
             # remote mode must not stage data it will never read
             if directory is not None:
                 if remote_fs.is_remote_path(directory):
-                    directory = remote_fs.stage_directory(
-                        directory,
-                        cache_dir=cache_dir,
-                        shard_idx=shard_idx,
-                        shard_num=shard_num,
-                    )
+                    if p["stream"]:
+                        # streaming ingest: fetch partition bytes to
+                        # memory and parse them directly — zero local
+                        # disk (the reference likewise streams off HDFS
+                        # without staging, hdfs_file_io.cc:79-80)
+                        buffers = remote_fs.read_directory(
+                            directory,
+                            shard_idx=shard_idx,
+                            shard_num=shard_num,
+                        )
+                    else:
+                        directory = remote_fs.stage_directory(
+                            directory,
+                            cache_dir=cache_dir,
+                            shard_idx=shard_idx,
+                            shard_num=shard_num,
+                        )
                     # staging already applied the shard selection; the
                     # native re-filter on the staged names is a no-op
                 else:
                     directory = remote_fs.strip_local_scheme(directory)
             if files:
-                files = remote_fs.stage_files(files, cache_dir=cache_dir)
+                if p["stream"]:
+                    # stream= must never be dropped silently (the
+                    # scratch-poor operator would stage to disk anyway
+                    # and hit ENOSPC with no hint why)
+                    buffers = remote_fs.read_files(files)
+                else:
+                    files = remote_fs.stage_files(
+                        files, cache_dir=cache_dir
+                    )
         if (
             registry is not None
             and not registry.startswith("tcp://")
@@ -259,7 +283,22 @@ class Graph:
                 raise RuntimeError(f"remote graph init failed: {err}")
             return
         h = self._lib.eg_create()
-        if directory is not None:
+        if buffers is not None:
+            n = len(buffers)
+            names = (ctypes.c_char_p * n)(
+                *[name.encode() for name, _ in buffers]
+            )
+            bufs = (ctypes.c_void_p * n)()
+            lens = (ctypes.c_uint64 * n)()
+            for i, (_, blob) in enumerate(buffers):
+                bufs[i] = ctypes.cast(
+                    ctypes.c_char_p(blob), ctypes.c_void_p
+                )
+                lens[i] = len(blob)
+            # `buffers` stays referenced through the call; the engine
+            # copies during parse, so the bytes can drop right after
+            rc = self._lib.eg_load_buffers(h, bufs, lens, names, n)
+        elif directory is not None:
             rc = self._lib.eg_load(
                 h, directory.encode(), shard_idx, shard_num
             )

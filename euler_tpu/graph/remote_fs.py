@@ -101,6 +101,39 @@ def _fetch(fs, remote: str, local: str) -> None:
             os.unlink(tmp)
 
 
+def _shard_partitions(fs, root: str, shard_idx: int, shard_num: int):
+    """List this shard's ``.dat`` partition entries under ``root`` —
+    the ONE copy of the selection rule, shared by staged and streamed
+    ingest so the two modes can never pick different file sets. It
+    matches the native loader exactly (eg_engine.cc Engine::Load): a
+    name without a ``_<p>.dat`` suffix belongs to partition 0, so under
+    sharding it goes to shard 0, not to no shard.
+
+    Returns (partition entries, meta.json entry or None).
+    """
+    picked = []
+    meta = None
+    for ent in fs.ls(root, detail=True):
+        name = os.path.basename(ent["name"])
+        if name == "meta.json":
+            meta = ent
+            continue
+        if not name.endswith(".dat"):
+            continue
+        p = partition_index(name)
+        if p < 0:
+            p = 0
+        if shard_num > 1 and p % shard_num != shard_idx:
+            continue
+        picked.append(ent)
+    if not picked:
+        raise FileNotFoundError(
+            f"no .dat partitions for shard {shard_idx}/{shard_num} "
+            f"in {root}"
+        )
+    return picked, meta
+
+
 def stage_directory(
     url: str,
     cache_dir: str | None = None,
@@ -121,27 +154,7 @@ def stage_directory(
     out = os.path.join(cache_dir or default_cache_dir(), key)
     os.makedirs(out, exist_ok=True)
 
-    entries = fs.ls(root, detail=True)
-    picked = []
-    meta = None
-    for ent in entries:
-        name = os.path.basename(ent["name"])
-        if name == "meta.json":
-            meta = ent
-            continue
-        if not name.endswith(".dat"):
-            continue
-        p = partition_index(name)
-        # p = -1 (unpartitioned) is skipped under sharding, exactly like
-        # the native rule (C++ -1 % n is negative, never == shard_idx;
-        # Python's modulo differs, so spell it out)
-        if shard_num > 1 and (p < 0 or p % shard_num != shard_idx):
-            continue
-        picked.append(ent)
-    if not picked:
-        raise FileNotFoundError(
-            f"no .dat partitions for shard {shard_idx}/{shard_num} in {url}"
-        )
+    picked, meta = _shard_partitions(fs, root, shard_idx, shard_num)
 
     want = picked + ([meta] if meta else [])
     keep = {os.path.basename(e["name"]) for e in want}
@@ -170,6 +183,57 @@ def stage_directory(
     # bandwidth; distinct files are safe to fetch in parallel
     with ThreadPoolExecutor(max_workers=min(8, len(want))) as ex:
         list(ex.map(fetch_one, want))
+    return out
+
+
+def read_directory(
+    url: str,
+    shard_idx: int = 0,
+    shard_num: int = 1,
+) -> list[tuple[str, bytes]]:
+    """Fetch this shard's ``.dat`` partitions straight into memory —
+    the STREAMING ingest path (``Graph(..., stream=True)``): bytes go
+    fetch → native parse → store with no local staging file, so a host
+    needs RAM for the graph but zero local disk (the stage-then-load
+    default additionally needs disk ≥ the shard's partition bytes; see
+    DEPLOY.md). Same shard-selection rule as stage_directory/eg_load.
+
+    Returns (basename, bytes) pairs; the native merge sorts by name, so
+    fetch completion order cannot change the built store.
+
+    RAM budget: the raw partition bytes, their parse-staging copies,
+    and the built store are all resident at the peak (inside the one
+    ``eg_load_buffers`` call) — plan for roughly raw + store, i.e.
+    ~2-3x the store alone. The staged default instead needs local disk
+    for the raw bytes and only ``nthreads`` files in memory at once.
+    """
+    fs, root = _filesystem(url)
+    picked, _ = _shard_partitions(fs, root, shard_idx, shard_num)
+    names = [ent["name"] for ent in picked]
+    with ThreadPoolExecutor(max_workers=min(8, len(names))) as ex:
+        blobs = list(ex.map(fs.cat_file, names))
+    return [(os.path.basename(p), b) for p, b in zip(names, blobs)]
+
+
+def read_files(urls: list[str]) -> list[tuple[str, bytes]]:
+    """Streamed counterpart of stage_files: fetch each file's bytes —
+    remote via fsspec, local straight off disk — with no staging copy.
+    The full URL/path is the returned name (basenames in an explicit
+    file list can collide, and the native merge sorts by name, so names
+    must be unique for the order to be deterministic).
+    """
+    out = []
+    for url in urls:
+        if is_remote_path(url):
+            fs, path = _filesystem(url)
+            try:
+                out.append((url, fs.cat_file(path)))
+            except FileNotFoundError:
+                raise FileNotFoundError(f"no such remote file: {url}")
+        else:
+            local = strip_local_scheme(url)
+            with open(local, "rb") as f:
+                out.append((url, f.read()))
     return out
 
 

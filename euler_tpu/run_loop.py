@@ -56,6 +56,11 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     )
     # graph
     p.add_argument("--data_dir", default="")
+    p.add_argument("--stream", type=_str2bool, default=False, help=(
+        "with a remote --data_dir URL (gs://, s3://, ...), parse "
+        "fetched partition bytes straight into the store instead of "
+        "staging them to local disk first (zero local scratch; "
+        "re-fetches each launch)"))
     p.add_argument("--graph_mode", default="local",
                    choices=["local", "remote", "shared"])
     p.add_argument("--registry", default="")
@@ -171,8 +176,20 @@ def build_graph(args):
     """Local / remote / shared graph init (reference tf_euler base.py:35-91:
     initialize_graph / initialize_shared_graph)."""
     services = []
+    if args.stream and args.graph_mode != "local":
+        # the shard service stages deliberately (a long-lived serving
+        # host wants the warm cache); dropping the flag silently would
+        # leave a scratch-poor operator staging anyway and hitting
+        # ENOSPC with no hint why
+        raise ValueError(
+            "--stream is only supported with --graph_mode=local "
+            "(shared/remote services stage their shard to the local "
+            "cache; see DEPLOY.md 'Remote data')"
+        )
     if args.graph_mode == "local":
-        graph = euler_tpu.Graph(directory=args.data_dir)
+        graph = euler_tpu.Graph(
+            directory=args.data_dir, stream=args.stream
+        )
     elif args.graph_mode == "remote":
         graph = euler_tpu.Graph(
             mode="remote",

@@ -1,0 +1,42 @@
+#!/bin/sh
+# Event-driven chip-window capture. The relay to the single real TPU
+# wedges for hours and returns without warning (BENCH_NOTES wedge
+# post-mortems: it has died mid-session, between a successful probe and
+# the next backend init, and right after a green suite) — so on-chip
+# work must fire the moment a window opens, not when an operator
+# happens to look. Probe every INTERVAL seconds (default 600) in a
+# killable subprocess; on the first success run CMD once and exit with
+# its status. Start it detached at session start:
+#
+#   nohup setsid sh scripts/chip_watcher.sh >/tmp/chip_watcher.log 2>&1 &
+#
+# With no CMD, runs the full evidence queue (EULER_TPU_SWEEP=1
+# scripts/tpu_checks.sh). Every probe is timestamped so the log doubles
+# as the relay-availability record for the session.
+#   sh scripts/chip_watcher.sh [-i seconds] [cmd...]
+cd "$(dirname "$0")/.." || exit 1
+INTERVAL=600
+if [ "$1" = "-i" ]; then
+  INTERVAL="$2"
+  shift 2
+fi
+
+while :; do
+  if timeout -k 10 170 python -c "
+import sys
+from euler_tpu.parallel import probe_backend_once
+p, err = probe_backend_once(150)
+print('probe:', p or err, flush=True)
+sys.exit(0 if p else 1)
+"; then
+    echo "chip_watcher: probe succeeded at $(date -u +%H:%M:%S) — running queue" >&2
+    if [ "$#" -gt 0 ]; then
+      "$@"
+    else
+      EULER_TPU_SWEEP=1 sh scripts/tpu_checks.sh
+    fi
+    exit $?
+  fi
+  echo "chip_watcher: $(date -u +%H:%M:%S) relay still wedged; next probe in ${INTERVAL}s" >&2
+  sleep "$INTERVAL"
+done

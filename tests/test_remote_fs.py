@@ -204,3 +204,139 @@ def test_remote_mode_does_not_stage_directory(tmp_path, monkeypatch):
             retries=0,
             timeout_ms=50,
         )
+
+
+def test_stream_load_zero_local_disk(mem_graph_url, tmp_path):
+    """stream=True parses fetched bytes directly: no staging directory is
+    ever created (the host needs RAM for the store, zero local disk)."""
+    import euler_tpu
+
+    cache = str(tmp_path / "never_created")
+    g = euler_tpu.Graph(
+        directory=mem_graph_url, stream=True, cache_dir=cache
+    )
+    assert g.num_nodes > 0
+    assert not os.path.exists(cache)
+    ids = g.sample_node(8, -1)
+    nbr, w, t = g.sample_neighbor(ids, [0, 1], 4)
+    assert nbr.shape == (8, 4)
+    g.close()
+
+
+def test_stream_load_equals_staged_load(mem_graph_url, tmp_path):
+    """The streamed store is identical to the staged-then-loaded store:
+    same nodes, same full neighbor lists, regardless of fetch order."""
+    import numpy as np
+
+    import euler_tpu
+
+    gs = euler_tpu.Graph(directory=mem_graph_url, stream=True)
+    gd = euler_tpu.Graph(
+        directory=mem_graph_url, cache_dir=str(tmp_path / "cache")
+    )
+    assert gs.num_nodes == gd.num_nodes
+    ids = np.arange(gs.num_nodes, dtype=np.uint64)
+    for etypes in ([0], [1], [0, 1]):
+        ns, ws, _, cs = gs.get_full_neighbor(ids, etypes)
+        nd, wd, _, cd = gd.get_full_neighbor(ids, etypes)
+        np.testing.assert_array_equal(cs, cd)
+        np.testing.assert_array_equal(ns, nd)
+        np.testing.assert_array_equal(ws, wd)
+    gs.close()
+    gd.close()
+
+
+def test_stream_sharded_load(mem_graph_url):
+    """Shard selection applies to streamed partitions exactly like
+    staged ones: two shards cover the graph disjointly."""
+    import euler_tpu
+
+    g0 = euler_tpu.Graph(
+        directory=mem_graph_url, stream=True, shard_idx=0, shard_num=2
+    )
+    g1 = euler_tpu.Graph(
+        directory=mem_graph_url, stream=True, shard_idx=1, shard_num=2
+    )
+    full = euler_tpu.Graph(directory=mem_graph_url, stream=True)
+    assert g0.num_nodes + g1.num_nodes == full.num_nodes
+    for g in (g0, g1, full):
+        g.close()
+
+
+def test_stream_via_config_string(mem_graph_url):
+    import euler_tpu
+
+    g = euler_tpu.Graph(
+        config=f"directory={mem_graph_url};stream=true"
+    )
+    assert g.num_nodes > 0
+    g.close()
+
+
+def test_stream_corrupt_buffer_names_partition(mem_graph_url):
+    """A parse failure in a streamed buffer surfaces as a Python error
+    naming the partition, never a crash across the C ABI."""
+    import euler_tpu
+
+    fs = fsspec.filesystem("memory")
+    with fs.open("/fixture_graph/part_1.dat", "wb") as f:
+        f.write(b"\x00\x01garbage-not-a-graph")
+    with pytest.raises(RuntimeError, match="part_1.dat"):
+        euler_tpu.Graph(directory=mem_graph_url, stream=True)
+
+
+def test_suffixless_dat_belongs_to_shard_zero(tmp_path):
+    """A .dat without the _<p> suffix is partition 0 under sharding —
+    the native rule (eg_engine.cc Engine::Load) — in BOTH ingest modes,
+    so a streamed or staged shard 0 matches the local loader exactly."""
+    import euler_tpu
+    from tests.fixture_graph import write_fixture
+
+    src = tmp_path / "src"
+    src.mkdir()
+    write_fixture(str(src), num_partitions=2)
+    fs = fsspec.filesystem("memory")
+    os.rename(src / "part_0.dat", src / "plain.dat")  # suffix-less
+    for name in os.listdir(src):
+        with open(src / name, "rb") as f:
+            data = f.read()
+        with fs.open(f"/suffixless/{name}", "wb") as f:
+            f.write(data)
+    url = "memory://suffixless"
+    try:
+        local0 = euler_tpu.Graph(
+            directory=str(src), shard_idx=0, shard_num=2
+        )
+        stream0 = euler_tpu.Graph(
+            directory=url, stream=True, shard_idx=0, shard_num=2
+        )
+        staged0 = euler_tpu.Graph(
+            directory=url, shard_idx=0, shard_num=2,
+            cache_dir=str(tmp_path / "cache"),
+        )
+        stream1 = euler_tpu.Graph(
+            directory=url, stream=True, shard_idx=1, shard_num=2
+        )
+        assert stream0.num_nodes == local0.num_nodes
+        assert staged0.num_nodes == local0.num_nodes
+        assert stream0.num_nodes + stream1.num_nodes > stream1.num_nodes
+        for g in (local0, stream0, staged0, stream1):
+            g.close()
+    finally:
+        fs.rm("/suffixless", recursive=True)
+
+
+def test_stream_explicit_file_list(mem_graph_url, tmp_path):
+    """files= + stream=True fetches each file's bytes directly (no
+    staging copy) and builds the same store as the staged path."""
+    import euler_tpu
+
+    urls = [mem_graph_url + f"/part_{i}.dat" for i in range(4)]
+    cache = str(tmp_path / "never_created")
+    gs = euler_tpu.Graph(files=urls, stream=True, cache_dir=cache)
+    gd = euler_tpu.Graph(files=urls, cache_dir=str(tmp_path / "cache"))
+    assert not os.path.exists(cache)
+    assert gs.num_nodes == gd.num_nodes
+    assert gs.num_edges == gd.num_edges
+    gs.close()
+    gd.close()

@@ -115,3 +115,37 @@ def test_walk_trials_cli(graph):
     )
     model = build_model(args, graph)
     assert model.module.walk_trials == 16
+
+
+def test_train_streamed_remote_data(fixture_dir, tmp_path, monkeypatch):
+    """--stream true trains off a remote URL with zero local staging
+    (the scratch-poor-host path; DEPLOY.md 'Remote data')."""
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    for name in os.listdir(fixture_dir):
+        with open(os.path.join(fixture_dir, name), "rb") as f:
+            data = f.read()
+        with fs.open(f"/rl_stream/{name}", "wb") as f:
+            f.write(data)
+    cache = str(tmp_path / "never_staged")
+    monkeypatch.setenv("EULER_TPU_CACHE", cache)
+    try:
+        rc = main(_args("memory://rl_stream", str(tmp_path / "ck_stream"),
+                        "--stream", "true",
+                        "--model", "graphsage_supervised",
+                        "--mode", "train"))
+        assert rc == 0
+        assert not os.path.exists(cache)
+    finally:
+        fs.rm("/rl_stream", recursive=True)
+
+
+def test_stream_rejected_outside_local_mode(fixture_dir, tmp_path):
+    """--stream must never be dropped silently: shared/remote modes
+    stage deliberately, so the flag errors out loudly there."""
+    with pytest.raises(ValueError, match="graph_mode=local"):
+        main(_args(fixture_dir, str(tmp_path / "ck"),
+                   "--stream", "true", "--graph_mode", "shared",
+                   "--registry", str(tmp_path / "reg"),
+                   "--model", "graphsage_supervised", "--mode", "train"))
