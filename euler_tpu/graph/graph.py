@@ -47,6 +47,14 @@ def _default_u64(default_node: int) -> int:
     return int(np.int64(default_node).view(np.uint64))
 
 
+def str2bool(v) -> bool:
+    """ONE truthy-string rule for every bool that can arrive as text
+    (config strings here, CLI flags in run_loop) — two parsers with
+    different accepted spellings is how `stream=y` silently stages to
+    disk while `--stream y` streams."""
+    return str(v).lower() in ("1", "true", "yes", "y")
+
+
 def parse_config(source: str) -> dict:
     """Parse a client config: a ``.ini``-style file of ``key = value``
     lines ('#'/';' comments, optional [sections] ignored) or an inline
@@ -161,10 +169,18 @@ class Graph:
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
-            stream = stream.lower() in ("1", "true", "yes")
+            stream = str2bool(stream)
         init = str(pick("init", init, "eager")).lower()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
+        if stream and mode != "local":
+            # never dropped silently: remote mode reads no graph data
+            # itself, so accepting the flag would just mislead
+            raise ValueError(
+                "stream=True applies to mode='local' graphs "
+                "(remote-mode clients read from shard services, which "
+                "stage their own data; see DEPLOY.md 'Remote data')"
+            )
         if init not in ("eager", "lazy"):
             raise ValueError("init must be 'eager' or 'lazy'")
         self._params = dict(
@@ -237,7 +253,10 @@ class Graph:
                     # native re-filter on the staged names is a no-op
                 else:
                     directory = remote_fs.strip_local_scheme(directory)
-            if files:
+            if files and directory is None:
+                # directory= wins at the load dispatch below; fetching
+                # or staging a files= list that will then be ignored is
+                # pure waste (and under stream=, RAM)
                 if p["stream"]:
                     # stream= must never be dropped silently (the
                     # scratch-poor operator would stage to disk anyway

@@ -101,7 +101,8 @@ def _fetch(fs, remote: str, local: str) -> None:
             os.unlink(tmp)
 
 
-def _shard_partitions(fs, root: str, shard_idx: int, shard_num: int):
+def _shard_partitions(fs, root: str, shard_idx: int, shard_num: int,
+                      url: str | None = None):
     """List this shard's ``.dat`` partition entries under ``root`` —
     the ONE copy of the selection rule, shared by staged and streamed
     ingest so the two modes can never pick different file sets. It
@@ -127,9 +128,11 @@ def _shard_partitions(fs, root: str, shard_idx: int, shard_num: int):
             continue
         picked.append(ent)
     if not picked:
+        # report the URL the caller actually passed, not the
+        # scheme-stripped root — the error must map back to the config
         raise FileNotFoundError(
             f"no .dat partitions for shard {shard_idx}/{shard_num} "
-            f"in {root}"
+            f"in {url or root}"
         )
     return picked, meta
 
@@ -154,7 +157,7 @@ def stage_directory(
     out = os.path.join(cache_dir or default_cache_dir(), key)
     os.makedirs(out, exist_ok=True)
 
-    picked, meta = _shard_partitions(fs, root, shard_idx, shard_num)
+    picked, meta = _shard_partitions(fs, root, shard_idx, shard_num, url)
 
     want = picked + ([meta] if meta else [])
     keep = {os.path.basename(e["name"]) for e in want}
@@ -208,7 +211,7 @@ def read_directory(
     for the raw bytes and only ``nthreads`` files in memory at once.
     """
     fs, root = _filesystem(url)
-    picked, _ = _shard_partitions(fs, root, shard_idx, shard_num)
+    picked, _ = _shard_partitions(fs, root, shard_idx, shard_num, url)
     names = [ent["name"] for ent in picked]
     with ThreadPoolExecutor(max_workers=min(8, len(names))) as ex:
         blobs = list(ex.map(fs.cat_file, names))
@@ -222,19 +225,21 @@ def read_files(urls: list[str]) -> list[tuple[str, bytes]]:
     file list can collide, and the native merge sorts by name, so names
     must be unique for the order to be deterministic).
     """
-    out = []
-    for url in urls:
+    def fetch_one(url: str) -> tuple[str, bytes]:
         if is_remote_path(url):
             fs, path = _filesystem(url)
             try:
-                out.append((url, fs.cat_file(path)))
+                return url, fs.cat_file(path)
             except FileNotFoundError:
                 raise FileNotFoundError(f"no such remote file: {url}")
-        else:
-            local = strip_local_scheme(url)
-            with open(local, "rb") as f:
-                out.append((url, f.read()))
-    return out
+        local = strip_local_scheme(url)
+        with open(local, "rb") as f:
+            return url, f.read()
+
+    # concurrent like stage/read_directory: object stores serve objects
+    # far below host bandwidth
+    with ThreadPoolExecutor(max_workers=min(8, len(urls))) as ex:
+        return list(ex.map(fetch_one, urls))
 
 
 def stage_files(

@@ -35,8 +35,9 @@ from euler_tpu import train as train_lib
 log = logging.getLogger("euler_tpu")
 
 
-def _str2bool(v: str) -> bool:
-    return str(v).lower() in ("1", "true", "yes", "y")
+# one truthy-string rule shared with Graph's config parsing — the CLI
+# and config-string spellings must accept the same values
+from euler_tpu.graph.graph import str2bool as _str2bool  # noqa: E402
 
 
 def _int_list(v) -> list[int]:

@@ -304,3 +304,70 @@ def test_corrupt_dat_never_crashes(tmp_path):
         f"{r.stdout.strip().splitlines()[-1:]}\n{r.stderr[-1500:]}"
     )
     assert "SWEPT" in r.stdout
+
+
+def test_corrupt_bytes_streamed_never_crash(tmp_path):
+    """The streamed ingest (eg_load_buffers) must reject malformed
+    bytes as cleanly as the file loader: byte flips (strided — the
+    parser is shared with the file path, which sweeps every offset),
+    the historical crash-class int32 overwrites, truncations, and the
+    empty buffer, in a crash-isolated subprocess."""
+    import subprocess
+    import sys
+    import textwrap
+
+    child = textwrap.dedent(
+        """
+        import os, random, struct, sys, tempfile
+        import euler_tpu
+        from tests.fixture_graph import write_fixture
+
+        base = tempfile.mkdtemp()
+        write_fixture(base, num_partitions=1)
+        dats = [f for f in os.listdir(base) if f.endswith(".dat")]
+        path = os.path.join(base, dats[0])
+        orig = open(path, "rb").read()
+
+        def attempt(data, label):
+            with open(path, "wb") as f:
+                f.write(data)
+            print("attempt", label, flush=True)  # last line names a crash
+            try:
+                g = euler_tpu.Graph(files=[path], stream=True)
+                g.close()
+                return "loaded"
+            except RuntimeError:
+                return "rejected"
+
+        rejected = loaded = 0
+        for i in range(0, len(orig), 3):
+            data = bytearray(orig); data[i] ^= 0xFF
+            r = attempt(bytes(data), f"flip@{i}")
+            rejected += r == "rejected"; loaded += r == "loaded"
+        rng = random.Random(11)
+        for trial in range(200):
+            off = rng.randrange(0, len(orig) - 4) & ~3
+            val = rng.choice([-1, -2, 2**31 - 1, -(2**31), 2**20 + 1])
+            data = bytearray(orig)
+            data[off:off + 4] = struct.pack("<i", val)
+            attempt(bytes(data), f"int32@{off}={val}")
+        for n in (0, 1, 7, len(orig) // 3, len(orig) - 1):
+            attempt(orig[:n], f"trunc@{n}")
+        assert attempt(b"", "empty") == "loaded"
+        assert rejected > 30 and loaded > 0, (rejected, loaded)
+        print(f"SWEPT streamed: rejected={rejected} loaded={loaded}")
+        """
+    )
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    r = subprocess.run(
+        [sys.executable, "-c", child], capture_output=True, text=True,
+        timeout=240, env=env,
+    )
+    assert r.returncode == 0, (
+        f"streamed loader crashed (rc={r.returncode}) at: "
+        f"{r.stdout.strip().splitlines()[-1:]}\n{r.stderr[-1500:]}"
+    )
+    assert "SWEPT" in r.stdout

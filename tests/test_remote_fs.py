@@ -319,7 +319,9 @@ def test_suffixless_dat_belongs_to_shard_zero(tmp_path):
         )
         assert stream0.num_nodes == local0.num_nodes
         assert staged0.num_nodes == local0.num_nodes
-        assert stream0.num_nodes + stream1.num_nodes > stream1.num_nodes
+        full = euler_tpu.Graph(directory=url, stream=True)
+        assert stream0.num_nodes + stream1.num_nodes == full.num_nodes
+        full.close()
         for g in (local0, stream0, staged0, stream1):
             g.close()
     finally:
