@@ -236,6 +236,8 @@ def read_files(urls: list[str]) -> list[tuple[str, bytes]]:
         with open(local, "rb") as f:
             return url, f.read()
 
+    if not urls:
+        return []
     # concurrent like stage/read_directory: object stores serve objects
     # far below host bandwidth
     with ThreadPoolExecutor(max_workers=min(8, len(urls))) as ex:

@@ -177,3 +177,23 @@ def test_heavytail_config_has_no_shape_literals(bench):
                 "alpha", "multilabel", "batch", "fanouts", "dim", "lr",
                 "warmup", "measure"):
         assert key in merged, key
+
+
+def test_default_configs_gated_on_heavytail_cache(bench, tmp_path,
+                                                  monkeypatch):
+    """The no-flag config list includes the 113.7M-edge flagship ONLY
+    when its cache is finished with current params — an absent cache
+    must never trigger an implicit multi-minute rebuild mid-window."""
+    monkeypatch.setenv("EULER_TPU_HEAVYTAIL_CACHE", str(tmp_path / "no"))
+    assert bench.default_configs() == "reddit,ppi"
+
+    from euler_tpu.datasets import (
+        REDDIT_HEAVYTAIL, heavytail_cache_dir, powerlaw_cache_ready,
+    )
+
+    real = os.path.join(os.path.dirname(_BENCH_PY), ".data", "reddit_ht")
+    monkeypatch.setenv("EULER_TPU_HEAVYTAIL_CACHE", real)
+    if powerlaw_cache_ready(heavytail_cache_dir(), **REDDIT_HEAVYTAIL):
+        assert bench.default_configs() == "reddit_heavytail,reddit,ppi"
+    else:
+        assert bench.default_configs() == "reddit,ppi"
