@@ -4,9 +4,11 @@
 # post-mortems: it has died mid-session, between a successful probe and
 # the next backend init, and right after a green suite) — so on-chip
 # work must fire the moment a window opens, not when an operator
-# happens to look. Probe every INTERVAL seconds (default 600) in a
-# killable subprocess; on the first success run CMD once and exit with
-# its status. Start it detached at session start:
+# happens to look. Probe every INTERVAL seconds (default 240: the one
+# observed window this round lasted ~25 min and a probe cycle costs
+# ≤170 s, so a 600 s sleep could eat half a window) in a killable
+# subprocess; on the first success run CMD once and exit with its
+# status. Start it detached at session start:
 #
 #   nohup setsid sh scripts/chip_watcher.sh >/tmp/chip_watcher.log 2>&1 &
 #
@@ -15,7 +17,7 @@
 # as the relay-availability record for the session.
 #   sh scripts/chip_watcher.sh [-i seconds] [cmd...]
 cd "$(dirname "$0")/.." || exit 1
-INTERVAL=600
+INTERVAL=240
 if [ "$1" = "-i" ]; then
   INTERVAL="$2"
   shift 2
