@@ -133,6 +133,71 @@ CONFIGS = {
     ),
 }
 
+def detect_pallas_kernel(state) -> bool:
+    """True when the fused Pallas draw kernel is active for this train
+    state (packed slab consts present — the on/off decision is made at
+    init_state time by add_sampling_consts -> available()). ONE copy of
+    the detection, shared with scripts/batch_sweep.py."""
+    return bool(
+        any(
+            "packed" in a
+            for a in state.get("consts", {}).get("adj", {}).values()
+        )
+    )
+
+
+def kernel_ab(model, opt, graph, batch_size: int, chunk_steps: int,
+              kernel_steps_per_sec: float, chunks: int = 4) -> dict:
+    """Measure the SAME config with the Pallas kernel forced off and
+    return {xla_path_steps_per_sec, kernel_step_speedup} (or
+    {ab_error}). Shared by run_config's headline A/B and the batch
+    sweep's per-point A/B — the env-toggle save/run/restore protocol
+    must not fork. Caller must free its own kernel-path state first:
+    this uploads a second full state (slabs + params + opt)."""
+    import jax
+
+    from euler_tpu import train as train_lib
+
+    out = {}
+    prior = os.environ.get("EULER_TPU_PALLAS_SAMPLING")
+    os.environ["EULER_TPU_PALLAS_SAMPLING"] = "0"
+    try:
+        state_x = model.init_state(
+            jax.random.PRNGKey(0), graph,
+            graph.sample_node(batch_size, -1), opt,
+        )
+        scan_x = jax.jit(
+            train_lib.make_scan_train(model, opt, chunk_steps, batch_size),
+            donate_argnums=(0,),
+        )
+        state_x, lx = scan_x(state_x, 0)
+        jax.block_until_ready(lx)
+        t0 = time.perf_counter()
+        for c in range(1, chunks + 1):
+            state_x, lx = scan_x(state_x, c)
+        jax.block_until_ready(lx)
+        x_dt = time.perf_counter() - t0
+        x_ms = x_dt / (chunks * chunk_steps) * 1e3
+        bogus = _implausible(x_ms, lx)
+        if bogus:
+            out["ab_error"] = f"measurement rejected: {bogus}"
+        else:
+            x_sps = chunks * chunk_steps / x_dt
+            out["xla_path_steps_per_sec"] = round(x_sps, 2)
+            out["kernel_step_speedup"] = round(
+                kernel_steps_per_sec / x_sps, 3
+            )
+        del state_x
+    except Exception as e:
+        out["ab_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        if prior is None:
+            os.environ.pop("EULER_TPU_PALLAS_SAMPLING", None)
+        else:
+            os.environ["EULER_TPU_PALLAS_SAMPLING"] = prior
+    return out
+
+
 def probe_backend(attempts: int, timeout_s: float, backoff_s: float):
     """Initialize the ambient (TPU) backend in a killable subprocess
     (euler_tpu.parallel.probe_backend_once — the ONE probe shared with
@@ -494,14 +559,9 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
             jax.random.PRNGKey(0), graph,
             graph.sample_node(batch_size, -1), opt,
         )
-        # record whether the fused Pallas draw kernel is active (packed
-        # slabs present) — on single-chip TPU it should be
-        ds["pallas_kernel"] = bool(
-            any(
-                "packed" in a
-                for a in state_ds.get("consts", {}).get("adj", {}).values()
-            )
-        )
+        # record whether the fused Pallas draw kernel is active — on
+        # single-chip TPU it should be
+        ds["pallas_kernel"] = detect_pallas_kernel(state_ds)
         state_ds = jax.device_put(state_ds, rep)
         chunk_steps = 50
         scan = jax.jit(
@@ -575,49 +635,10 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
             and ds.get("pallas_kernel")
             and "implausible" not in ds
         ):
-            prior = os.environ.get("EULER_TPU_PALLAS_SAMPLING")
-            os.environ["EULER_TPU_PALLAS_SAMPLING"] = "0"
-            try:
-                # the kernel on/off decision is made at init_state time
-                # (add_sampling_consts -> available()), so the SAME model
-                # object measures the same config on the other path
-                state_x = model_ds.init_state(
-                    jax.random.PRNGKey(0), graph,
-                    graph.sample_node(batch_size, -1), opt,
-                )
-                state_x = jax.device_put(state_x, rep)
-                scan_x = jax.jit(
-                    train_lib.make_scan_train(
-                        model_ds, opt, chunk_steps, batch_size
-                    ),
-                    donate_argnums=(0,),
-                )
-                state_x, lx = scan_x(state_x, 0)
-                jax.block_until_ready(lx)
-                ab_chunks = 4
-                t3 = time.perf_counter()
-                for c in range(1, ab_chunks + 1):
-                    state_x, lx = scan_x(state_x, c)
-                jax.block_until_ready(lx)
-                x_dt = time.perf_counter() - t3
-                x_wall_ms = x_dt / (ab_chunks * chunk_steps) * 1e3
-                x_bogus = _implausible(x_wall_ms, lx)
-                if x_bogus:
-                    ds["ab_error"] = f"measurement rejected: {x_bogus}"
-                else:
-                    x_sps = ab_chunks * chunk_steps / x_dt
-                    ds["xla_path_steps_per_sec"] = round(x_sps, 2)
-                    ds["kernel_step_speedup"] = round(
-                        ds["steps_per_sec"] / x_sps, 3
-                    )
-                del state_x
-            except Exception as e:
-                ds["ab_error"] = f"{type(e).__name__}: {e}"[:200]
-            finally:
-                if prior is None:
-                    os.environ.pop("EULER_TPU_PALLAS_SAMPLING", None)
-                else:
-                    os.environ["EULER_TPU_PALLAS_SAMPLING"] = prior
+            ds.update(kernel_ab(
+                model_ds, opt, graph, batch_size, chunk_steps,
+                ds["steps_per_sec"], chunks=4,
+            ))
     except Exception as e:  # never lose the host-path number
         ds["error"] = f"{type(e).__name__}: {e}"[:300]
 

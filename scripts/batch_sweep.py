@@ -127,6 +127,7 @@ def sweep_config(name: str, batches, out_path: str) -> None:
                 jax.random.PRNGKey(0), graph,
                 graph.sample_node(batch, -1), opt,
             )
+            point["pallas_kernel"] = bench.detect_pallas_kernel(state)
             chunk_steps = 50
             scan = jax.jit(
                 train_lib.make_scan_train(model, opt, chunk_steps, batch),
@@ -160,6 +161,17 @@ def sweep_config(name: str, batches, out_path: str) -> None:
                 except Exception:
                     pass
             del state
+            if (platform != "cpu" and point.get("pallas_kernel")
+                    and "error" not in point):
+                # per-point kernel A/B: does the fused draw still matter
+                # off the latency corner? Shared helper (bench.kernel_ab)
+                # so the env-toggle protocol cannot fork; the main state
+                # is freed first — two full states resident would double
+                # peak HBM at the big batch points.
+                point.update(bench.kernel_ab(
+                    model, opt, graph, batch, chunk_steps,
+                    point["steps_per_sec"], chunks=2,
+                ))
         except Exception as e:  # noqa: BLE001 — bank the failure, move on
             point["error"] = f"{type(e).__name__}: {e}"[:300]
         _bank_line(point)
@@ -176,8 +188,10 @@ def main() -> None:
     ap.add_argument("--platform", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--deadline", type=float, default=None,
                     help="per-config subprocess deadline (s); x3 on CPU. "
-                    "Default: per-config (900 s; reddit_heavytail 2400 s "
-                    "— one alias upload plus a compile per batch point)")
+                    "Default: per-config (900 s, +700 s on TPU for the "
+                    "per-point kernel A/B's second init+compile; "
+                    "reddit_heavytail 2400 s — one alias upload plus a "
+                    "compile per batch point, no A/B on the alias path)")
     args = ap.parse_args()
     batches = [int(b) for b in args.batches.split(",") if b.strip()]
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
@@ -210,13 +224,16 @@ def main() -> None:
     if child_platform == "cpu":
         print(json.dumps({"note": f"CPU fallback: {err}"}), file=sys.stderr)
     # the heavytail sweep does strictly more than bench's single point
-    # (same graph load + alias upload, then a compile per batch point)
+    # (same graph load + alias upload, then a compile per batch point);
+    # on TPU, ppi/reddit points also each pay the kernel A/B's second
+    # init_state + compile, hence the +700 below (CPU runs no A/B)
     caps = {"reddit_heavytail": 2400.0}
     for name in [n.strip() for n in args.configs.split(",") if n.strip()]:
         deadline = (
             args.deadline
             if args.deadline is not None
             else caps.get(name, 900.0)
+            + (0.0 if child_platform == "cpu" else 700.0)
         ) * (3.0 if child_platform == "cpu" else 1.0)
         cmd = [
             sys.executable, "-u", os.path.abspath(__file__),
