@@ -8,6 +8,7 @@
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -33,13 +34,39 @@ inline GraphAPI* API(void* h) { return static_cast<GraphAPI*>(h); }
 inline Engine* Local(void* h) { return static_cast<Engine*>(API(h)); }
 }  // namespace
 
+// Exception barrier for the C ABI (eg-lint rule abi-barrier): an exception
+// unwinding past extern "C" into ctypes frames is std::terminate (SIGABRT)
+// for the host Python process, so every entry point runs its body inside
+//   try { ... } EG_API_GUARD(<sentinel>)
+// and failures land in g_last_error + the sentinel return instead.
+#define EG_API_GUARD(...)                      \
+  catch (const std::exception& ex) {           \
+    g_last_error = ex.what();                  \
+    return __VA_ARGS__;                        \
+  } catch (...) {                              \
+    g_last_error = "unknown native exception"; \
+    return __VA_ARGS__;                        \
+  }
+
 extern "C" {
 
+// eg-lint: allow(abi-barrier) the error reporter itself: returns a
+// thread_local buffer, cannot throw, and must never clobber the error state
 const char* eg_last_error() { return g_last_error.c_str(); }
 
-void* eg_create() { return static_cast<GraphAPI*>(new Engine()); }
+void* eg_create() {
+  try {
+    return static_cast<GraphAPI*>(new Engine());
+  }
+  EG_API_GUARD(nullptr)
+}
 
-void eg_destroy(void* h) { delete API(h); }
+void eg_destroy(void* h) {
+  try {
+    delete API(h);
+  }
+  EG_API_GUARD()
+}
 
 int eg_load(void* h, const char* dir, int shard_idx, int shard_num) {
   auto* e = Local(h);
@@ -93,71 +120,110 @@ int eg_load_buffers(void* h, const void* const* bufs, const uint64_t* lens,
   return 0;
 }
 
-void eg_seed(uint64_t seed) { eg::SeedThreadRng(seed); }
+void eg_seed(uint64_t seed) {
+  try {
+    eg::SeedThreadRng(seed);
+  }
+  EG_API_GUARD()
+}
 
 // ---- remote mode (Graph::NewGraph(mode=Remote) equivalent,
 // reference euler/client/graph.cc:157-185) ----
 // Config: "registry=<dir>" or "shards=h:p|h:p,..." (+ retries/timeout_ms/
 // quarantine_ms). Returns a handle usable with every query function below,
-// or nullptr (see eg_last_error).
+// or nullptr (see eg_last_error). A config that fails to parse (e.g.
+// "retries=x", std::stoi throws) lands in the guard, not std::terminate.
 void* eg_remote_create(const char* config) {
-  auto* g = new RemoteGraph();
-  if (!g->Init(config ? config : "")) {
-    g_last_error = g->error();
-    delete g;
-    return nullptr;
+  try {
+    auto g = std::make_unique<RemoteGraph>();
+    if (!g->Init(config ? config : "")) {
+      g_last_error = g->error();
+      return nullptr;
+    }
+    return static_cast<GraphAPI*>(g.release());
   }
-  return static_cast<GraphAPI*>(g);
+  EG_API_GUARD(nullptr)
 }
 
 int eg_remote_shards(void* h) {
-  return static_cast<RemoteGraph*>(API(h))->num_shards();
+  try {
+    return static_cast<RemoteGraph*>(API(h))->num_shards();
+  }
+  EG_API_GUARD(-1)
 }
 int eg_remote_partitions(void* h) {
-  return static_cast<RemoteGraph*>(API(h))->num_partitions();
+  try {
+    return static_cast<RemoteGraph*>(API(h))->num_partitions();
+  }
+  EG_API_GUARD(-1)
 }
 // Current replica count of one shard's pool — observability for the
 // mid-run re-discovery path (and its tests).
 int eg_remote_replica_count(void* h, int shard) {
-  return static_cast<int>(
-      static_cast<RemoteGraph*>(API(h))->num_replicas(shard));
+  try {
+    return static_cast<int>(
+        static_cast<RemoteGraph*>(API(h))->num_replicas(shard));
+  }
+  EG_API_GUARD(-1)
 }
 
 // ---- graph service (StartService equivalent,
 // reference euler/service/python_api.cc:26-52) ----
 void* eg_service_start(const char* data_dir, int shard_idx, int shard_num,
                        const char* host, int port, const char* registry_dir) {
-  auto* s = new Service();
-  if (!s->Start(data_dir, shard_idx, shard_num, host ? host : "",
-                port, registry_dir ? registry_dir : "")) {
-    g_last_error = s->error();
-    delete s;
-    return nullptr;
+  try {
+    auto s = std::make_unique<Service>();
+    if (!s->Start(data_dir, shard_idx, shard_num, host ? host : "",
+                  port, registry_dir ? registry_dir : "")) {
+      g_last_error = s->error();
+      return nullptr;
+    }
+    return s.release();
   }
-  return s;
+  EG_API_GUARD(nullptr)
 }
 
-int eg_service_port(void* s) { return static_cast<Service*>(s)->port(); }
+int eg_service_port(void* s) {
+  try {
+    return static_cast<Service*>(s)->port();
+  }
+  EG_API_GUARD(-1)
+}
 
-void eg_service_stop(void* s) { delete static_cast<Service*>(s); }
+void eg_service_stop(void* s) {
+  try {
+    delete static_cast<Service*>(s);
+  }
+  EG_API_GUARD()
+}
 
 // ---- TCP shard registry (ZooKeeper discovery equivalent,
 // reference euler/common/zk_server_register.cc + zk_server_monitor.cc) ----
 void* eg_registry_start(const char* host, int port, int ttl_ms) {
-  auto* r = new RegistryServer();
-  if (!r->Start(host ? host : "", port, ttl_ms)) {
-    g_last_error = r->error();
-    delete r;
-    return nullptr;
+  try {
+    auto r = std::make_unique<RegistryServer>();
+    if (!r->Start(host ? host : "", port, ttl_ms)) {
+      g_last_error = r->error();
+      return nullptr;
+    }
+    return r.release();
   }
-  return r;
+  EG_API_GUARD(nullptr)
 }
 
 int eg_registry_port(void* r) {
-  return static_cast<RegistryServer*>(r)->port();
+  try {
+    return static_cast<RegistryServer*>(r)->port();
+  }
+  EG_API_GUARD(-1)
 }
 
-void eg_registry_stop(void* r) { delete static_cast<RegistryServer*>(r); }
+void eg_registry_stop(void* r) {
+  try {
+    delete static_cast<RegistryServer*>(r);
+  }
+  EG_API_GUARD()
+}
 
 // LIST a registry at host:port into caller-supplied buf as
 // "<shard> <host>:<port>\n" lines. Returns bytes written, or -1 when the
@@ -165,52 +231,92 @@ void eg_registry_stop(void* r) { delete static_cast<RegistryServer*>(r); }
 // last complete line (never mid-entry, so the result always parses).
 int eg_registry_query(const char* host, int port, int timeout_ms, char* buf,
                       int cap) {
-  std::map<int, std::vector<std::string>> listed;
-  if (!RegistryList(host ? host : "127.0.0.1", port, timeout_ms, &listed))
-    return -1;
-  std::string out;
-  for (auto& [shard, addrs] : listed)
-    for (auto& a : addrs)
-      out += std::to_string(shard) + " " + a + "\n";
-  size_t n = out.size();
-  if (n > static_cast<size_t>(cap)) {
-    size_t nl = out.rfind('\n', static_cast<size_t>(cap) - 1);
-    n = nl == std::string::npos ? 0 : nl + 1;
+  try {
+    std::map<int, std::vector<std::string>> listed;
+    if (!RegistryList(host ? host : "127.0.0.1", port, timeout_ms, &listed))
+      return -1;
+    std::string out;
+    for (auto& [shard, addrs] : listed)
+      for (auto& a : addrs)
+        out += std::to_string(shard) + " " + a + "\n";
+    size_t n = out.size();
+    if (n > static_cast<size_t>(cap)) {
+      size_t nl = out.rfind('\n', static_cast<size_t>(cap) - 1);
+      n = nl == std::string::npos ? 0 : nl + 1;
+    }
+    if (n > 0) memcpy(buf, out.data(), n);
+    return static_cast<int>(n);
   }
-  if (n > 0) memcpy(buf, out.data(), n);
-  return static_cast<int>(n);
+  EG_API_GUARD(-1)
 }
 
 // ---- introspection ----
-int64_t eg_num_nodes(void* h) { return API(h)->NumNodes(); }
-int64_t eg_num_edges(void* h) { return API(h)->NumEdges(); }
-int32_t eg_node_type_num(void* h) { return API(h)->NodeTypeNum(); }
-int32_t eg_edge_type_num(void* h) { return API(h)->EdgeTypeNum(); }
+int64_t eg_num_nodes(void* h) {
+  try {
+    return API(h)->NumNodes();
+  }
+  EG_API_GUARD(-1)
+}
+int64_t eg_num_edges(void* h) {
+  try {
+    return API(h)->NumEdges();
+  }
+  EG_API_GUARD(-1)
+}
+int32_t eg_node_type_num(void* h) {
+  try {
+    return API(h)->NodeTypeNum();
+  }
+  EG_API_GUARD(-1)
+}
+int32_t eg_edge_type_num(void* h) {
+  try {
+    return API(h)->EdgeTypeNum();
+  }
+  EG_API_GUARD(-1)
+}
 // kind: 0=node u64, 1=node f32, 2=node binary, 3=edge u64, 4=edge f32,
 // 5=edge binary.
-int32_t eg_feature_num(void* h, int kind) { return API(h)->FeatureNum(kind); }
+int32_t eg_feature_num(void* h, int kind) {
+  try {
+    return API(h)->FeatureNum(kind);
+  }
+  EG_API_GUARD(-1)
+}
 // Per-type weight sums for cross-shard weighted sampling; out has
 // node_type_num (kind 0) or edge_type_num (kind 1) floats.
 void eg_type_weight_sums(void* h, int kind, float* out) {
-  API(h)->TypeWeightSums(kind, out);
+  try {
+    API(h)->TypeWeightSums(kind, out);
+  }
+  EG_API_GUARD()
 }
 
 // ---- sampling ----
 void eg_sample_node(void* h, int count, int32_t type, uint64_t* out) {
-  eg::SpanTimer span(eg::kStatSampleNode);
-  API(h)->SampleNode(count, type, out);
+  try {
+    eg::SpanTimer span(eg::kStatSampleNode);
+    API(h)->SampleNode(count, type, out);
+  }
+  EG_API_GUARD()
 }
 
 void eg_sample_edge(void* h, int count, int32_t type, uint64_t* out_src,
                     uint64_t* out_dst, int32_t* out_type) {
-  eg::SpanTimer span(eg::kStatSampleEdge);
-  API(h)->SampleEdge(count, type, out_src, out_dst, out_type);
+  try {
+    eg::SpanTimer span(eg::kStatSampleEdge);
+    API(h)->SampleEdge(count, type, out_src, out_dst, out_type);
+  }
+  EG_API_GUARD()
 }
 
 void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
                              uint64_t* out) {
-  eg::SpanTimer span(eg::kStatSampleNode);
-  API(h)->SampleNodeWithSrc(src, n, count, out);
+  try {
+    eg::SpanTimer span(eg::kStatSampleNode);
+    API(h)->SampleNodeWithSrc(src, n, count, out);
+  }
+  EG_API_GUARD()
 }
 
 // Per-node sampling weights for the device-graph exporter; works in both
@@ -218,23 +324,32 @@ void eg_sample_node_with_src(void* h, const uint64_t* src, int n, int count,
 // success, -1 when any shard could not answer (the exporter must not
 // build a sampler from silently-zero weights).
 int eg_get_node_weight(void* h, const uint64_t* ids, int n, float* out) {
-  if (API(h)->GetNodeWeight(ids, n, out)) return 0;
-  g_last_error = "node_weights: one or more shards unreachable";
-  return -1;
+  try {
+    if (API(h)->GetNodeWeight(ids, n, out)) return 0;
+    g_last_error = "node_weights: one or more shards unreachable";
+    return -1;
+  }
+  EG_API_GUARD(-1)
 }
 
 void eg_get_node_type(void* h, const uint64_t* ids, int n, int32_t* out) {
-  eg::SpanTimer span(eg::kStatNodeType);
-  API(h)->GetNodeType(ids, n, out);
+  try {
+    eg::SpanTimer span(eg::kStatNodeType);
+    API(h)->GetNodeType(ids, n, out);
+  }
+  EG_API_GUARD()
 }
 
 void eg_sample_neighbor(void* h, const uint64_t* ids, int n,
                         const int32_t* etypes, int net, int count,
                         uint64_t default_id, uint64_t* out_ids, float* out_w,
                         int32_t* out_t) {
-  eg::SpanTimer span(eg::kStatSampleNeighbor);
-  API(h)->SampleNeighbor(ids, n, etypes, net, count,
-                                          default_id, out_ids, out_w, out_t);
+  try {
+    eg::SpanTimer span(eg::kStatSampleNeighbor);
+    API(h)->SampleNeighbor(ids, n, etypes, net, count, default_id, out_ids,
+                           out_w, out_t);
+  }
+  EG_API_GUARD()
 }
 
 // etypes_flat: concatenated per-hop edge-type lists; etype_counts[h] =
@@ -244,10 +359,12 @@ void eg_sample_fanout(void* h, const uint64_t* ids, int n,
                       const int32_t* etypes_flat, const int32_t* etype_counts,
                       const int32_t* counts, int nhops, uint64_t default_id,
                       uint64_t** out_ids, float** out_w, int32_t** out_t) {
-  eg::SpanTimer span(eg::kStatSampleFanout);
-  API(h)->SampleFanout(ids, n, etypes_flat, etype_counts,
-                                        counts, nhops, default_id, out_ids,
-                                        out_w, out_t);
+  try {
+    eg::SpanTimer span(eg::kStatSampleFanout);
+    API(h)->SampleFanout(ids, n, etypes_flat, etype_counts, counts, nhops,
+                         default_id, out_ids, out_w, out_t);
+  }
+  EG_API_GUARD()
 }
 
 // Flat-CSR alias-table build for the device-side exact sampler (pure
@@ -256,23 +373,31 @@ void eg_sample_fanout(void* h, const uint64_t* ids, int n,
 // eg::BuildAliasRows.
 void eg_build_alias_csr(const int64_t* offsets, int64_t num_rows,
                         const float* weights, float* prob, int32_t* alias) {
-  eg::BuildAliasRows(offsets, num_rows, weights, prob, alias);
+  try {
+    eg::BuildAliasRows(offsets, num_rows, weights, prob, alias);
+  }
+  EG_API_GUARD()
 }
 
 void* eg_get_full_neighbor(void* h, const uint64_t* ids, int n,
                            const int32_t* etypes, int net, int sorted) {
-  eg::SpanTimer span(eg::kStatFullNeighbor);
-  return API(h)->GetFullNeighbor(ids, n, etypes, net,
-                                                  sorted != 0);
+  try {
+    eg::SpanTimer span(eg::kStatFullNeighbor);
+    return API(h)->GetFullNeighbor(ids, n, etypes, net, sorted != 0);
+  }
+  EG_API_GUARD(nullptr)
 }
 
 void eg_get_top_k_neighbor(void* h, const uint64_t* ids, int n,
                            const int32_t* etypes, int net, int k,
                            uint64_t default_id, uint64_t* out_ids,
                            float* out_w, int32_t* out_t) {
-  eg::SpanTimer span(eg::kStatTopKNeighbor);
-  API(h)->GetTopKNeighbor(ids, n, etypes, net, k, default_id,
-                                           out_ids, out_w, out_t);
+  try {
+    eg::SpanTimer span(eg::kStatTopKNeighbor);
+    API(h)->GetTopKNeighbor(ids, n, etypes, net, k, default_id, out_ids,
+                            out_w, out_t);
+  }
+  EG_API_GUARD()
 }
 
 // etypes_flat/etype_counts: per-step edge-type segments (walk_len segments).
@@ -280,119 +405,164 @@ void eg_random_walk(void* h, const uint64_t* ids, int n,
                     const int32_t* etypes_flat, const int32_t* etype_counts,
                     int walk_len, float p, float q, uint64_t default_id,
                     uint64_t* out) {
-  eg::SpanTimer span(eg::kStatRandomWalk);
-  API(h)->RandomWalk(ids, n, etypes_flat, etype_counts,
-                                      walk_len, p, q, default_id, out);
+  try {
+    eg::SpanTimer span(eg::kStatRandomWalk);
+    API(h)->RandomWalk(ids, n, etypes_flat, etype_counts, walk_len, p, q,
+                       default_id, out);
+  }
+  EG_API_GUARD()
 }
 
 // ---- features ----
 void eg_get_dense_feature(void* h, const uint64_t* ids, int n,
                           const int32_t* fids, const int32_t* dims, int nf,
                           float* out) {
-  eg::SpanTimer span(eg::kStatDenseFeature);
-  API(h)->GetDenseFeature(ids, n, fids, dims, nf, out);
+  try {
+    eg::SpanTimer span(eg::kStatDenseFeature);
+    API(h)->GetDenseFeature(ids, n, fids, dims, nf, out);
+  }
+  EG_API_GUARD()
 }
 
 void eg_get_edge_dense_feature(void* h, const uint64_t* src,
                                const uint64_t* dst, const int32_t* types,
                                int n, const int32_t* fids,
                                const int32_t* dims, int nf, float* out) {
-  eg::SpanTimer span(eg::kStatDenseFeature);
-  API(h)->GetEdgeDenseFeature(src, dst, types, n, fids, dims,
-                                               nf, out);
+  try {
+    eg::SpanTimer span(eg::kStatDenseFeature);
+    API(h)->GetEdgeDenseFeature(src, dst, types, n, fids, dims, nf, out);
+  }
+  EG_API_GUARD()
 }
 
 void* eg_get_sparse_feature(void* h, const uint64_t* ids, int n,
                             const int32_t* fids, int nf) {
-  eg::SpanTimer span(eg::kStatSparseFeature);
-  return API(h)->GetSparseFeature(ids, n, fids, nf);
+  try {
+    eg::SpanTimer span(eg::kStatSparseFeature);
+    return API(h)->GetSparseFeature(ids, n, fids, nf);
+  }
+  EG_API_GUARD(nullptr)
 }
 
 void* eg_get_edge_sparse_feature(void* h, const uint64_t* src,
                                  const uint64_t* dst, const int32_t* types,
                                  int n, const int32_t* fids, int nf) {
-  eg::SpanTimer span(eg::kStatSparseFeature);
-  return API(h)->GetEdgeSparseFeature(src, dst, types, n,
-                                                       fids, nf);
+  try {
+    eg::SpanTimer span(eg::kStatSparseFeature);
+    return API(h)->GetEdgeSparseFeature(src, dst, types, n, fids, nf);
+  }
+  EG_API_GUARD(nullptr)
 }
 
 void* eg_get_binary_feature(void* h, const uint64_t* ids, int n,
                             const int32_t* fids, int nf) {
-  eg::SpanTimer span(eg::kStatBinaryFeature);
-  return API(h)->GetBinaryFeature(ids, n, fids, nf);
+  try {
+    eg::SpanTimer span(eg::kStatBinaryFeature);
+    return API(h)->GetBinaryFeature(ids, n, fids, nf);
+  }
+  EG_API_GUARD(nullptr)
 }
 
 void* eg_get_edge_binary_feature(void* h, const uint64_t* src,
                                  const uint64_t* dst, const int32_t* types,
                                  int n, const int32_t* fids, int nf) {
-  eg::SpanTimer span(eg::kStatBinaryFeature);
-  return API(h)->GetEdgeBinaryFeature(src, dst, types, n,
-                                                       fids, nf);
+  try {
+    eg::SpanTimer span(eg::kStatBinaryFeature);
+    return API(h)->GetEdgeBinaryFeature(src, dst, types, n, fids, nf);
+  }
+  EG_API_GUARD(nullptr)
 }
 
 // ---- result access ----
 // kind: 0=u64, 1=f32, 2=i32, 3=bytes; slot indexes within that kind.
 int64_t eg_result_size(void* r, int kind, int slot) {
-  auto* res = static_cast<EGResult*>(r);
-  switch (kind) {
-    case 0:
-      return slot < static_cast<int>(res->u64.size())
-                 ? static_cast<int64_t>(res->u64[slot].size())
-                 : -1;
-    case 1:
-      return slot < static_cast<int>(res->f32.size())
-                 ? static_cast<int64_t>(res->f32[slot].size())
-                 : -1;
-    case 2:
-      return slot < static_cast<int>(res->i32.size())
-                 ? static_cast<int64_t>(res->i32[slot].size())
-                 : -1;
-    case 3:
-      return slot < static_cast<int>(res->bytes.size())
-                 ? static_cast<int64_t>(res->bytes[slot].size())
-                 : -1;
-    default:
-      return -1;
+  try {
+    auto* res = static_cast<EGResult*>(r);
+    switch (kind) {
+      case 0:
+        return slot < static_cast<int>(res->u64.size())
+                   ? static_cast<int64_t>(res->u64[slot].size())
+                   : -1;
+      case 1:
+        return slot < static_cast<int>(res->f32.size())
+                   ? static_cast<int64_t>(res->f32[slot].size())
+                   : -1;
+      case 2:
+        return slot < static_cast<int>(res->i32.size())
+                   ? static_cast<int64_t>(res->i32[slot].size())
+                   : -1;
+      case 3:
+        return slot < static_cast<int>(res->bytes.size())
+                   ? static_cast<int64_t>(res->bytes[slot].size())
+                   : -1;
+      default:
+        return -1;
+    }
   }
+  EG_API_GUARD(-1)
 }
 
 void eg_result_copy(void* r, int kind, int slot, void* out) {
-  auto* res = static_cast<EGResult*>(r);
-  switch (kind) {
-    case 0:
-      std::memcpy(out, res->u64[slot].data(),
-                  res->u64[slot].size() * sizeof(uint64_t));
-      break;
-    case 1:
-      std::memcpy(out, res->f32[slot].data(),
-                  res->f32[slot].size() * sizeof(float));
-      break;
-    case 2:
-      std::memcpy(out, res->i32[slot].data(),
-                  res->i32[slot].size() * sizeof(int32_t));
-      break;
-    case 3:
-      std::memcpy(out, res->bytes[slot].data(), res->bytes[slot].size());
-      break;
+  try {
+    auto* res = static_cast<EGResult*>(r);
+    switch (kind) {
+      case 0:
+        std::memcpy(out, res->u64[slot].data(),
+                    res->u64[slot].size() * sizeof(uint64_t));
+        break;
+      case 1:
+        std::memcpy(out, res->f32[slot].data(),
+                    res->f32[slot].size() * sizeof(float));
+        break;
+      case 2:
+        std::memcpy(out, res->i32[slot].data(),
+                    res->i32[slot].size() * sizeof(int32_t));
+        break;
+      case 3:
+        std::memcpy(out, res->bytes[slot].data(), res->bytes[slot].size());
+        break;
+    }
   }
+  EG_API_GUARD()
 }
 
-void eg_result_free(void* r) { delete static_cast<EGResult*>(r); }
+void eg_result_free(void* r) {
+  try {
+    delete static_cast<EGResult*>(r);
+  }
+  EG_API_GUARD()
+}
 
 
 // ---- stats (span-timer subsystem, eg_stats.h) ----
-int eg_stat_count() { return eg::kStatOpCount; }
+int eg_stat_count() {
+  try {
+    return eg::kStatOpCount;
+  }
+  EG_API_GUARD(0)
+}
 
 const char* eg_stat_name(int i) {
-  return (i >= 0 && i < eg::kStatOpCount) ? eg::kStatNames[i] : "";
+  try {
+    return (i >= 0 && i < eg::kStatOpCount) ? eg::kStatNames[i] : "";
+  }
+  EG_API_GUARD("")
 }
 
 // out arrays sized eg_stat_count().
 void eg_stats_snapshot(uint64_t* counts, uint64_t* total_ns,
                        uint64_t* max_ns) {
-  eg::Stats::Global().Snapshot(counts, total_ns, max_ns);
+  try {
+    eg::Stats::Global().Snapshot(counts, total_ns, max_ns);
+  }
+  EG_API_GUARD()
 }
 
-void eg_stats_reset() { eg::Stats::Global().Reset(); }
+void eg_stats_reset() {
+  try {
+    eg::Stats::Global().Reset();
+  }
+  EG_API_GUARD()
+}
 
 }  // extern "C"

@@ -33,6 +33,8 @@ bool ReadWholeFile(const std::string& path, std::string* out) {
   if (!f) return false;
   std::streamsize size = f.tellg();
   f.seekg(0);
+  // eg-lint: allow(wire-count-alloc) sized by tellg of an already-open
+  // local file — the bytes exist on disk; bad_alloc surfaces via eg_load
   out->resize(static_cast<size_t>(size));
   return static_cast<bool>(f.read(out->data(), size));
 }

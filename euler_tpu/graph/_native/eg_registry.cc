@@ -22,7 +22,15 @@ bool RegistryServer::Start(const std::string& host, int port, int ttl_ms) {
     return false;
   }
   stopping_ = false;
-  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  accept_thread_ = std::thread([this] {
+    try {
+      AcceptLoop();
+    } catch (...) {
+      // std::terminate barrier (eg-lint: thread-catch): a dead accept
+      // loop stops admitting connections; registrants' heartbeats fail
+      // loudly instead of the whole process aborting
+    }
+  });
   return true;
 }
 
@@ -56,7 +64,12 @@ void RegistryServer::AcceptLoop() {
     }
     active_conns_.fetch_add(1, std::memory_order_acq_rel);
     std::thread([this, fd] {
-      HandleConn(fd);
+      try {
+        HandleConn(fd);
+      } catch (...) {
+        // one hostile client must not std::terminate the registry
+        // (eg-lint: thread-catch); cleanup below still runs
+      }
       {
         std::lock_guard<std::mutex> l(mu_);
         conn_fds_.erase(fd);

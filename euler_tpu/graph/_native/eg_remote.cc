@@ -46,19 +46,27 @@ bool ParseHostPort(const std::string& s, std::string* host, int* port) {
 }
 
 // Decode an EGResult encoded by the service (see WriteResult in
-// eg_service.cc).
+// eg_service.cc). Every encoded slot costs at least 8 bytes (its i64
+// length prefix), so a slot count beyond remaining()/8 cannot be honest:
+// reject it before the resize below turns a hostile count from a
+// malformed reply into a multi-GB zero-fill (the round-2 service crash
+// class, service-side fix in OversizedResult; this is the client side).
 bool ReadResult(WireReader* r, EGResult* out) {
   int32_t n = r->I32();
-  out->u64.resize(std::max(n, 0));
+  if (n < 0 || static_cast<uint64_t>(n) > r->remaining() / 8) return false;
+  out->u64.resize(n);
   for (auto& v : out->u64) r->Vec(&v);
   n = r->I32();
-  out->f32.resize(std::max(n, 0));
+  if (n < 0 || static_cast<uint64_t>(n) > r->remaining() / 8) return false;
+  out->f32.resize(n);
   for (auto& v : out->f32) r->Vec(&v);
   n = r->I32();
-  out->i32.resize(std::max(n, 0));
+  if (n < 0 || static_cast<uint64_t>(n) > r->remaining() / 8) return false;
+  out->i32.resize(n);
   for (auto& v : out->i32) r->Vec(&v);
   n = r->I32();
-  out->bytes.resize(std::max(n, 0));
+  if (n < 0 || static_cast<uint64_t>(n) > r->remaining() / 8) return false;
+  out->bytes.resize(n);
   for (auto& s : out->bytes) s = r->Str();
   return r->ok();
 }
@@ -384,7 +392,15 @@ bool RemoteGraph::Init(const std::string& config) {
                        : 3000;
   if (rediscover_ms_ > 0 && (!reg_host_.empty() || !reg_dir_.empty())) {
     rediscover_stop_ = false;
-    rediscover_thread_ = std::thread([this] { RediscoverLoop(); });
+    rediscover_thread_ = std::thread([this] {
+      try {
+        RediscoverLoop();
+      } catch (...) {
+        // std::terminate barrier (eg-lint: thread-catch): losing
+        // re-discovery degrades to the static replica set; quarantine
+        // still routes around dead hosts
+      }
+    });
   }
   return true;
 }
@@ -412,7 +428,16 @@ void RemoteGraph::ForShards(const std::vector<std::vector<int32_t>>& rows,
   std::vector<std::thread> ts;
   ts.reserve(rows.size());
   for (int s = 0; s < static_cast<int>(rows.size()); ++s)
-    if (!rows[s].empty()) ts.emplace_back([&fn, s] { fn(s); });
+    if (!rows[s].empty())
+      ts.emplace_back([&fn, s] {
+        try {
+          fn(s);
+        } catch (...) {
+          // std::terminate barrier (eg-lint: thread-catch): a throwing
+          // shard call degrades like a failed one — its rows keep their
+          // prefilled defaults
+        }
+      });
   for (auto& t : ts) t.join();
 }
 
@@ -577,18 +602,23 @@ void RemoteGraph::SampleNodeWithSrc(const uint64_t* src, int n, int count,
   std::vector<std::thread> ts;
   for (auto& [key, slots] : groups) {
     ts.emplace_back([this, &key = key, &slots = slots, out] {
-      WireWriter req;
-      req.U8(kSampleNode);
-      req.I32(static_cast<int32_t>(slots.size()));
-      req.I32(key.second);
-      std::string reply;
-      if (!Call(key.first, req.buf(), &reply)) return;
-      WireReader r(reply);
-      r.U8();
-      int64_t m;
-      const uint64_t* ids = r.Arr<uint64_t>(&m);
-      if (!r.ok() || m != static_cast<int64_t>(slots.size())) return;
-      for (int64_t j = 0; j < m; ++j) out[slots[j]] = ids[j];
+      try {
+        WireWriter req;
+        req.U8(kSampleNode);
+        req.I32(static_cast<int32_t>(slots.size()));
+        req.I32(key.second);
+        std::string reply;
+        if (!Call(key.first, req.buf(), &reply)) return;
+        WireReader r(reply);
+        r.U8();
+        int64_t m;
+        const uint64_t* ids = r.Arr<uint64_t>(&m);
+        if (!r.ok() || m != static_cast<int64_t>(slots.size())) return;
+        for (int64_t j = 0; j < m; ++j) out[slots[j]] = ids[j];
+      } catch (...) {
+        // std::terminate barrier (eg-lint: thread-catch): this group's
+        // slots keep their prefilled zeros, like a failed Call
+      }
     });
   }
   for (auto& t : ts) t.join();

@@ -76,7 +76,15 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
     return false;
   }
   stopping_ = false;
-  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  accept_thread_ = std::thread([this] {
+    try {
+      AcceptLoop();
+    } catch (...) {
+      // an exception escaping a thread entry is std::terminate for the
+      // whole process (eg-lint: thread-catch); a dead accept loop just
+      // stops admitting new connections until the service restarts
+    }
+  });
 
   if (registry_dir.compare(0, 6, "tcp://") == 0) {
     // TCP registry (eg_registry.h): REG now, then heartbeat re-REG at a
@@ -103,23 +111,29 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
     }
     heartbeat_stop_ = false;
     heartbeat_thread_ = std::thread([this, line, fd, ttl_ms]() mutable {
-      while (!heartbeat_stop_.load(std::memory_order_acquire)) {
-        // wake every 50 ms so Stop() stays prompt even with short TTLs
-        int beat_ms = ttl_ms / 3 > 150 ? ttl_ms / 3 : 150;
-        for (int slept = 0; slept < beat_ms && !heartbeat_stop_;
-             slept += 50)
-          std::this_thread::sleep_for(std::chrono::milliseconds(50));
-        if (heartbeat_stop_) break;
-        if (fd < 0 || !RegistrySend(fd, line, &ttl_ms)) {
-          if (fd >= 0) ::close(fd);
-          fd = DialTcp(reg_host_, reg_port_, 2000);
-          if (fd >= 0) RegistrySend(fd, line, &ttl_ms);
+      try {
+        while (!heartbeat_stop_.load(std::memory_order_acquire)) {
+          // wake every 50 ms so Stop() stays prompt even with short TTLs
+          int beat_ms = ttl_ms / 3 > 150 ? ttl_ms / 3 : 150;
+          for (int slept = 0; slept < beat_ms && !heartbeat_stop_;
+               slept += 50)
+            std::this_thread::sleep_for(std::chrono::milliseconds(50));
+          if (heartbeat_stop_) break;
+          if (fd < 0 || !RegistrySend(fd, line, &ttl_ms)) {
+            if (fd >= 0) ::close(fd);
+            fd = DialTcp(reg_host_, reg_port_, 2000);
+            if (fd >= 0) RegistrySend(fd, line, &ttl_ms);
+          }
         }
-      }
-      if (fd >= 0) {
-        RegistrySend(fd, "UNREG " + std::to_string(shard_idx_) + " " +
-                             host_ + ":" + std::to_string(port_));
-        ::close(fd);
+        if (fd >= 0) {
+          RegistrySend(fd, "UNREG " + std::to_string(shard_idx_) + " " +
+                               host_ + ":" + std::to_string(port_));
+          ::close(fd);
+        }
+      } catch (...) {
+        // std::terminate barrier (eg-lint: thread-catch): a dead heartbeat
+        // only lets the registry entry expire; rediscovery handles the rest
+        if (fd >= 0) ::close(fd);
       }
     });
   } else if (!registry_dir.empty()) {
@@ -177,7 +191,26 @@ void Service::AcceptLoop() {
       conn_fds_.insert(fd);
     }
     active_conns_.fetch_add(1, std::memory_order_acq_rel);
-    std::thread([this, fd] { HandleConn(fd); }).detach();
+    std::thread([this, fd] {
+      try {
+        HandleConn(fd);
+      } catch (...) {
+        // an exception escaping this detached thread is std::terminate
+        // for the whole service (eg-lint: thread-catch) — one hostile
+        // connection (e.g. a frame whose recv buffer cannot be
+        // allocated) must not take the shard down
+      }
+      // Deregister before close — outside HandleConn so it runs even
+      // when the handler throws: Stop() busy-waits on active_conns_ and
+      // only shuts down fds still in the set, so it can never touch a
+      // closed (possibly recycled) descriptor.
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        conn_fds_.erase(fd);
+      }
+      ::close(fd);
+      active_conns_.fetch_sub(1, std::memory_order_acq_rel);
+    }).detach();
   }
 }
 
@@ -189,9 +222,8 @@ void Service::HandleConn(int fd) {
     try {
       Dispatch(req, &reply);
     } catch (const std::exception& ex) {
-      // an exception escaping this detached thread is std::terminate
-      // for the whole service — one malformed client must not take the
-      // shard down
+      // a malformed request must come back as an error reply, not tear
+      // down the connection
       WireWriter e;
       e.U8(1);
       e.Str(std::string("server error: ") + ex.what());
@@ -199,14 +231,6 @@ void Service::HandleConn(int fd) {
     }
     if (!SendFrame(fd, reply)) break;
   }
-  // Deregister before close: Stop() only shuts down fds still in the set,
-  // so it can never touch a closed (possibly recycled) descriptor.
-  {
-    std::lock_guard<std::mutex> l(mu_);
-    conn_fds_.erase(fd);
-  }
-  ::close(fd);
-  active_conns_.fetch_sub(1, std::memory_order_acq_rel);
 }
 
 namespace {

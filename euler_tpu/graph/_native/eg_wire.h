@@ -84,6 +84,11 @@ class WireReader {
   explicit WireReader(const std::string& s) : p_(s.data()), n_(s.size()) {}
 
   bool ok() const { return ok_; }
+  // Bytes left unread — the bound for counts decoded from the payload:
+  // any honest element/slot count costs at least its encoding's bytes,
+  // so callers reject counts beyond remaining()/<min bytes per entry>
+  // before allocating (eg-lint rule wire-count-alloc).
+  size_t remaining() const { return n_ - off_; }
 
   uint8_t U8() {
     uint8_t v = 0;

@@ -18,10 +18,8 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from euler_tpu import ops
 from euler_tpu.models import base

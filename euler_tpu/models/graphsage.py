@@ -13,7 +13,6 @@ from typing import Optional, Sequence
 import flax.linen as nn
 import numpy as np
 
-from euler_tpu import ops
 from euler_tpu.models import base
 from euler_tpu.nn import metrics
 from euler_tpu.nn.encoders import (

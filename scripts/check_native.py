@@ -1,0 +1,642 @@
+#!/usr/bin/env python3
+"""Structural invariant linter for the native graph engine.
+
+Machine-checks the crash-class rules the sanitizer/fuzz rounds taught us
+(SANITIZERS.md), so a future PR cannot silently reintroduce them. No
+libclang: this is a line/brace-aware scan of euler_tpu/graph/_native —
+deliberately structural, because every rule below names a *shape* of code
+(a missing catch, a raw pointer-overflow compare, an unbounded resize)
+that survives renaming and refactoring.
+
+Rules (each individually testable, see tests/test_static_analysis.py):
+
+  abi-barrier      every function defined inside an `extern "C"` block
+                   wraps its body in a try/catch barrier (or EG_API_GUARD).
+                   An exception crossing the C ABI is std::terminate ->
+                   SIGABRT for the host Python process.
+  ptr-arith-bounds no `p + n * sizeof(T) > end` style bounds compares:
+                   the addition overflows for corrupt huge n and slips
+                   past the bound (the round-2 loader crash class). Use
+                   division against remaining(), like eg::ByteCursor.
+  thread-catch     every thread entry lambda (std::thread ctor or
+                   emplace_back on a vector<std::thread>) has a top-level
+                   catch: an exception escaping a thread entry is
+                   std::terminate for the whole process.
+  wire-count-alloc no resize/reserve/new[]/sized-container-construction on
+                   a wire- or file-derived count without a preceding bound
+                   check (the round-2 service fix: a well-framed request
+                   demanding a terabyte result must be rejected before
+                   allocation).
+  raw-lock         no raw .lock()/.unlock() calls — RAII guards only
+                   (lock_guard/unique_lock/scoped_lock), so no early
+                   return or exception can leak a held mutex.
+  thread-rng       no rand()/srand(): they are process-global and not
+                   thread-safe under the OpenMP/pthread samplers — use
+                   eg::ThreadRng().
+
+Escapes: a rule can be waived per line with
+
+    // eg-lint: allow(<rule>) <reason>
+
+on the offending line or the line directly above (for function-level
+rules: the function header line, the line above it, or the first body
+line). The reason is mandatory — an escape without one is itself a
+violation — so every exception stays visible in review.
+
+Usage:
+    python scripts/check_native.py                # lint the repo tree
+    python scripts/check_native.py FILE [FILE...] # lint specific files
+    python scripts/check_native.py --list-rules
+
+Exit codes: 0 clean, 1 violations found, 2 bad invocation / unreadable file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import os
+import re
+import sys
+
+RULES = {
+    "abi-barrier": "extern \"C\" functions need a try/catch exception barrier",
+    "ptr-arith-bounds": "overflow-prone `p + n * sizeof(T)` bounds compare",
+    "thread-catch": "thread entry points need a top-level catch",
+    "wire-count-alloc": "allocation on a wire/file-derived count without a bound check",
+    "raw-lock": "raw .lock()/.unlock() — use RAII guards",
+    "thread-rng": "rand()/srand() — use eg::ThreadRng()",
+    "allow-escape": "malformed eg-lint allow escape",
+}
+
+ALLOW_RE = re.compile(r"eg-lint:\s*allow\(([\w-]+)\)\s*(.*)")
+
+
+@dataclasses.dataclass
+class Violation:
+    path: str
+    line: int  # 1-based
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Source preparation
+# ---------------------------------------------------------------------------
+
+
+def strip_comments_and_strings(text: str):
+    """Blank out comments and string/char literals, preserving line layout.
+
+    Returns (code, allows) where `code` has every comment/literal byte
+    replaced by a space (newlines kept, so offsets and line numbers line
+    up with the original), and `allows` maps 1-based line number ->
+    list of (rule, reason) parsed from eg-lint allow comments.
+    """
+    out = []
+    allows: dict[int, list[tuple[str, str]]] = {}
+    i, n = 0, len(text)
+    line = 1
+    state = "code"  # code | line_comment | block_comment | string | char
+    comment_start = 0
+    while i < n:
+        c = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if state == "code":
+            if c == "/" and nxt == "/":
+                state = "line_comment"
+                comment_start = i
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                state = "block_comment"
+                comment_start = i
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                state = "string"
+                out.append(" ")
+                i += 1
+                continue
+            if c == "'":
+                state = "char"
+                out.append(" ")
+                i += 1
+                continue
+            out.append(c)
+        elif state in ("line_comment", "block_comment"):
+            ended = False
+            if state == "line_comment" and c == "\n":
+                ended = True
+            elif state == "block_comment" and c == "*" and nxt == "/":
+                text_of = text[comment_start : i + 2]
+                m = ALLOW_RE.search(text_of)
+                if m:
+                    allows.setdefault(line, []).append((m.group(1), m.group(2).strip()))
+                out.append("  ")
+                i += 2
+                state = "code"
+                continue
+            if ended:
+                m = ALLOW_RE.search(text[comment_start:i])
+                if m:
+                    allows.setdefault(line, []).append((m.group(1), m.group(2).strip()))
+                out.append("\n")
+                state = "code"
+            else:
+                out.append("\n" if c == "\n" else " ")
+        elif state in ("string", "char"):
+            if c == "\\":
+                out.append("  ")
+                i += 2
+                continue
+            if (state == "string" and c == '"') or (state == "char" and c == "'"):
+                state = "code"
+            out.append("\n" if c == "\n" else " ")
+        if c == "\n":
+            line += 1
+        i += 1
+    if state in ("line_comment", "block_comment"):
+        m = ALLOW_RE.search(text[comment_start:])
+        if m:
+            allows.setdefault(line, []).append((m.group(1), m.group(2).strip()))
+    return "".join(out), allows
+
+
+def line_of(code: str, offset: int) -> int:
+    return code.count("\n", 0, offset) + 1
+
+
+# ---------------------------------------------------------------------------
+# Block / function extraction (brace matching over stripped code)
+# ---------------------------------------------------------------------------
+
+CONTROL_KEYWORDS = ("if", "else", "for", "while", "switch", "do", "try", "catch")
+
+FUNC_TAIL_RE = re.compile(
+    r"\)\s*(?:const|noexcept|override|final|mutable|->\s*[\w:<>,&*\s]+)?\s*$"
+)
+LAMBDA_TAIL_RE = re.compile(
+    r"\[[^\[\]]*\]\s*(?:\([^()]*\))?\s*(?:mutable|noexcept)?\s*(?:->\s*[\w:<>,&*\s]+)?\s*$"
+)
+
+
+@dataclasses.dataclass
+class Block:
+    kind: str  # extern | namespace | type | function | lambda | control | block
+    name: str
+    header_line: int  # line of the text introducing the block
+    start: int  # offset of the opening brace
+    end: int = -1  # offset of the closing brace
+    parents: tuple = ()  # kinds of enclosing blocks, outermost first
+
+
+def classify_header(header: str) -> tuple[str, str]:
+    h = header.strip()
+    # string literals are blanked by strip_comments_and_strings, so an
+    # `extern "C"` block header survives as a bare `extern`
+    if re.match(r"extern\b", h) and "(" not in h:
+        return "extern", ""
+    if re.match(r"namespace\b", h):
+        return "namespace", h.split()[-1] if len(h.split()) > 1 else ""
+    if re.match(r"(class|struct|enum|union)\b", h) and "(" not in h:
+        m = re.match(r"(?:class|struct|enum(?:\s+class)?|union)\s+(\w+)", h)
+        return "type", m.group(1) if m else ""
+    first_word = re.match(r"[A-Za-z_]\w*", h)
+    if first_word and first_word.group(0) in CONTROL_KEYWORDS:
+        return "control", first_word.group(0)
+    if LAMBDA_TAIL_RE.search(h):
+        return "lambda", ""
+    if FUNC_TAIL_RE.search(h) and "(" in h:
+        # function definition: name is the identifier before the first
+        # paren at depth 0 of the header's own parens
+        m = re.search(r"([~\w:]+)\s*\(", h)
+        return "function", (m.group(1) if m else "")
+    return "block", ""
+
+
+def extract_blocks(code: str) -> list[Block]:
+    """Return all braced blocks with kind classification and extents."""
+    blocks: list[Block] = []
+    stack: list[Block] = []
+    header_start = 0
+    i, n = 0, len(code)
+    while i < n:
+        c = code[i]
+        if c in ";":
+            header_start = i + 1
+        elif c == "{":
+            header = code[header_start:i]
+            kind, name = classify_header(header)
+            # header line: the first non-blank line of the header, else
+            # the line of the brace itself
+            stripped_off = header_start + (len(header) - len(header.lstrip()))
+            hline = line_of(code, stripped_off if header.strip() else i)
+            blk = Block(
+                kind,
+                name,
+                hline,
+                i,
+                parents=tuple(b.kind for b in stack),
+            )
+            stack.append(blk)
+            blocks.append(blk)
+            header_start = i + 1
+        elif c == "}":
+            if stack:
+                stack.pop().end = i
+            header_start = i + 1
+        i += 1
+    return blocks
+
+
+# ---------------------------------------------------------------------------
+# Individual rules
+# ---------------------------------------------------------------------------
+
+CMP_RE = re.compile(r"(?<![-<>=!+*/|&^])(?:<=|>=|<|>)(?![<>=])")
+
+
+def strip_templates(line: str) -> str:
+    """Blank template argument lists so `static_cast<size_t>(n)` and
+    `std::max<int32_t>(a, b)` don't read as comparisons.
+
+    Only spans whose `<` directly follows an identifier character and whose
+    contents are type-ish (word chars, ::, commas, *, nested <>) are
+    blanked — `a < b && c > d` is left alone.
+    """
+    out = list(line)
+    i = 0
+    while i < len(line):
+        if line[i] == "<" and i > 0 and (line[i - 1].isalnum() or line[i - 1] == "_"):
+            depth = 1
+            j = i + 1
+            ok = True
+            while j < len(line) and depth:
+                c = line[j]
+                if c == "<":
+                    depth += 1
+                elif c == ">":
+                    depth -= 1
+                elif not (c.isalnum() or c in "_:, *\t"):
+                    ok = False
+                    break
+                j += 1
+            if ok and depth == 0:
+                for k in range(i, j):
+                    out[k] = " "
+                i = j
+                continue
+        i += 1
+    return "".join(out)
+# `... + n * sizeof(T)` and `... n * sizeof(T) + ...` inside a compare
+PTR_SUM_A = re.compile(r"\+\s*\(?\s*[\w.\[\]]+(?:->\w+)?\s*\)?\s*\*\s*sizeof\s*\(")
+PTR_SUM_B = re.compile(r"\*\s*sizeof\s*\([^)]*\)\s*\+")
+
+
+def rule_ptr_arith_bounds(path, code_lines, report):
+    for ln, text in enumerate(code_lines, 1):
+        if "sizeof" not in text:
+            continue
+        if (PTR_SUM_A.search(text) or PTR_SUM_B.search(text)) and CMP_RE.search(text):
+            report(
+                ln,
+                "ptr-arith-bounds",
+                "overflow-prone bounds compare: `p + n * sizeof(T)` wraps for "
+                "corrupt huge n — compare n against remaining()/sizeof(T) instead "
+                "(see eg::ByteCursor)",
+            )
+
+
+RAW_LOCK_RE = re.compile(r"(?:\.|->)\s*(lock|unlock)\s*\(\s*\)")
+
+
+def rule_raw_lock(path, code_lines, report):
+    for ln, text in enumerate(code_lines, 1):
+        m = RAW_LOCK_RE.search(text)
+        if m:
+            report(
+                ln,
+                "raw-lock",
+                f"raw .{m.group(1)}() — use std::lock_guard/std::unique_lock so "
+                "early returns and exceptions cannot leak the mutex",
+            )
+
+
+RAND_RE = re.compile(r"(?<![\w:])(s?rand)\s*\(")
+
+
+def rule_thread_rng(path, code_lines, report):
+    for ln, text in enumerate(code_lines, 1):
+        m = RAND_RE.search(text)
+        if m:
+            report(
+                ln,
+                "thread-rng",
+                f"{m.group(1)}() is process-global and racy under the parallel "
+                "samplers — use eg::ThreadRng()",
+            )
+
+
+TRY_RE = re.compile(r"\btry\b")
+CATCH_RE = re.compile(r"\bcatch\b|EG_API_GUARD")
+
+
+def rule_abi_barrier(path, code, blocks, report):
+    for blk in blocks:
+        if blk.kind != "function" or "extern" not in blk.parents:
+            continue
+        body = code[blk.start : blk.end + 1] if blk.end >= 0 else code[blk.start :]
+        if TRY_RE.search(body) and CATCH_RE.search(body):
+            continue
+        report(
+            blk.header_line,
+            "abi-barrier",
+            f"extern \"C\" function `{blk.name}` has no try/catch barrier — an "
+            "exception crossing the C ABI is std::terminate (SIGABRT) for the "
+            "host process",
+        )
+
+
+THREAD_SITE_RE = re.compile(r"std::thread\s*[({]")
+THREAD_VEC_RE = re.compile(r"std::vector\s*<\s*std::thread\s*>\s+(\w+)")
+
+
+def rule_thread_catch(path, code, report):
+    sites = [(m.start(), "std::thread") for m in THREAD_SITE_RE.finditer(code)]
+    vec_names = set(THREAD_VEC_RE.findall(code))
+    for name in vec_names:
+        for m in re.finditer(r"\b%s\s*\.\s*emplace_back\s*\(" % re.escape(name), code):
+            sites.append((m.start(), f"{name}.emplace_back"))
+    for off, what in sorted(sites):
+        ln = line_of(code, off)
+        # find the lambda argument: first '[' after the call opener
+        open_idx = code.find("(", off)
+        if open_idx < 0:
+            open_idx = code.find("{", off)
+        seg = code[open_idx + 1 : open_idx + 200] if open_idx >= 0 else ""
+        stripped = seg.lstrip()
+        if what == "std::thread" and (not stripped or stripped[0] != "["):
+            if not stripped or stripped[0] == ")":
+                continue  # declaration like `std::thread t;` / member decl
+            report(
+                ln,
+                "thread-catch",
+                "thread entry is not an inline lambda — wrap the callable in a "
+                "lambda with a top-level catch so an exception cannot "
+                "std::terminate the process",
+            )
+            continue
+        if what != "std::thread" and (not stripped or stripped[0] != "["):
+            report(
+                ln,
+                "thread-catch",
+                "thread entry is not an inline lambda — wrap the callable in a "
+                "lambda with a top-level catch",
+            )
+            continue
+        lam_start = open_idx + 1 + (len(seg) - len(stripped))
+        # skip capture list, optional params/specifiers, find body brace
+        cap_end = code.find("]", lam_start)
+        if cap_end < 0:
+            continue
+        j = cap_end + 1
+        depth = 0
+        body_start = -1
+        while j < len(code):
+            ch = code[j]
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "{" and depth == 0:
+                body_start = j
+                break
+            elif ch == ";" and depth == 0:
+                break
+            j += 1
+        if body_start < 0:
+            continue
+        depth = 0
+        k = body_start
+        while k < len(code):
+            if code[k] == "{":
+                depth += 1
+            elif code[k] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            k += 1
+        body = code[body_start : k + 1]
+        if not re.search(r"\bcatch\b", body):
+            report(
+                ln,
+                "thread-catch",
+                "thread entry lambda has no top-level catch — an exception "
+                "escaping a thread entry is std::terminate for the whole "
+                "process",
+            )
+
+
+# wire/file-derived scalar reads that taint a variable
+TAINT_RES = [
+    re.compile(r"\b(\w+)\s*=\s*[\w.]*(?:->)?\s*(?:I32|I64|U64|U8|F32|Pod(?:<[^;=]*>)?)\s*\(\s*\)"),
+    re.compile(r"\bRead\s*\(\s*&(\w+)\s*\)"),
+    re.compile(r"\bmemcpy\s*\(\s*&(\w+)\s*,"),
+    re.compile(r"\b(\w+)\s*=\s*\w+\.tellg\s*\(\s*\)"),
+]
+ALLOC_SINK_RES = [
+    re.compile(r"(?:\.|->)\s*(?:resize|reserve)\s*\(([^;]*)\)"),
+    re.compile(r"\bnew\s+[\w:<>]+\s*\[([^\]]*)\]"),
+    re.compile(r"\bstd::(?:vector|string)\s*<[^;=]*>\s+\w+\s*\(([^;]*)\)"),
+    re.compile(r"\bstd::string\s+\w+\s*\(([^;]*)\)"),
+]
+GUARD_NAME_RE = re.compile(r"(?i)\b\w*(oversiz|bound|cap|check|valid|clamp)\w*\s*\(")
+MIN_RE = re.compile(r"\bstd::min\b")
+
+
+def rule_wire_count_alloc(path, code, blocks, report):
+    funcs = [b for b in blocks if b.kind == "function" and b.end >= 0]
+    for blk in funcs:
+        # skip functions that contain nested functions (shouldn't happen in C++)
+        body = code[blk.start : blk.end + 1]
+        base_line = line_of(code, blk.start)
+        tainted: dict[str, int] = {}
+        for off_ln, text in enumerate(body.split("\n")):
+            ln = base_line + off_ln
+            # guards first: any comparison or bound-ish call naming the var
+            # (template args blanked so casts don't read as comparisons)
+            cleaned = strip_templates(text)
+            for var in list(tainted):
+                if re.search(r"\b%s\b" % re.escape(var), cleaned) and (
+                    CMP_RE.search(cleaned)
+                    or GUARD_NAME_RE.search(cleaned)
+                    or MIN_RE.search(cleaned)
+                ):
+                    del tainted[var]
+            for sink in ALLOC_SINK_RES:
+                for m in sink.finditer(text):
+                    arg = m.group(1)
+                    for var, src_ln in tainted.items():
+                        if re.search(r"\b%s\b" % re.escape(var), arg):
+                            report(
+                                ln,
+                                "wire-count-alloc",
+                                f"allocation sized by `{var}` (wire/file-derived "
+                                f"at line {src_ln}) with no preceding bound "
+                                "check — a hostile count forces a huge "
+                                "allocation before any data is validated",
+                            )
+            for taint in TAINT_RES:
+                for m in taint.finditer(text):
+                    tainted[m.group(1)] = ln
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def lint_text(text: str, path: str, rules=None) -> list[Violation]:
+    code, allows = strip_comments_and_strings(text)
+    code_lines = code.split("\n")
+    blocks = extract_blocks(code)
+    violations: list[Violation] = []
+    active = set(rules) if rules else set(RULES) - {"allow-escape"}
+
+    used_allows: set[int] = set()
+
+    def check_allow(cand: int, rule: str) -> bool:
+        for arule, reason in allows.get(cand, []):
+            if arule == rule:
+                used_allows.add(cand)
+                if not reason:
+                    violations.append(
+                        Violation(
+                            path,
+                            cand,
+                            "allow-escape",
+                            f"allow({rule}) escape has no reason — justify "
+                            "the exception so it is visible in review",
+                        )
+                    )
+                return True
+        return False
+
+    def report(ln: int, rule: str, message: str, window: int = 1):
+        # suppression: allow(rule) on the line itself, within `window`
+        # lines below (function-level rules cover the first body line), or
+        # in the run of comment/blank lines directly above
+        for cand in range(ln, ln + window + 1):
+            if check_allow(cand, rule):
+                return
+        cand = ln - 1
+        while cand >= 1:
+            if check_allow(cand, rule):
+                return
+            if cand <= len(code_lines) and code_lines[cand - 1].strip():
+                break  # real code without a matching allow: stop walking
+            cand -= 1
+        violations.append(Violation(path, ln, rule, message))
+
+    def freport(ln, rule, message):  # function-level: wider window
+        report(ln, rule, message, window=2)
+
+    if "ptr-arith-bounds" in active:
+        rule_ptr_arith_bounds(path, code_lines, report)
+    if "raw-lock" in active:
+        rule_raw_lock(path, code_lines, report)
+    if "thread-rng" in active:
+        rule_thread_rng(path, code_lines, report)
+    if "abi-barrier" in active:
+        rule_abi_barrier(path, code, blocks, freport)
+    if "thread-catch" in active:
+        rule_thread_catch(path, code, report)
+    if "wire-count-alloc" in active:
+        rule_wire_count_alloc(path, code, blocks, report)
+
+    # unknown-rule escapes are themselves violations (typo-proofing)
+    for ln, entries in allows.items():
+        for arule, _ in entries:
+            if arule not in RULES:
+                violations.append(
+                    Violation(
+                        path,
+                        ln,
+                        "allow-escape",
+                        f"allow({arule}) names an unknown rule "
+                        f"(known: {', '.join(sorted(set(RULES) - {'allow-escape'}))})",
+                    )
+                )
+    violations.sort(key=lambda v: (v.line, v.rule))
+    return violations
+
+
+def lint_file(path: str, rules=None) -> list[Violation]:
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return lint_text(f.read(), path, rules)
+
+
+def default_targets(root: str) -> list[str]:
+    native = os.path.join(root, "euler_tpu", "graph", "_native")
+    files = sorted(
+        glob.glob(os.path.join(native, "*.cc")) + glob.glob(os.path.join(native, "*.h"))
+    )
+    return files
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("paths", nargs="*", help="files to lint (default: the repo's _native tree)")
+    ap.add_argument("--rules", help="comma-separated subset of rules to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root for default target discovery",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, desc in RULES.items():
+            print(f"{name:18s} {desc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    targets = args.paths or default_targets(args.root)
+    if not targets:
+        print("no lint targets found", file=sys.stderr)
+        return 2
+
+    all_violations: list[Violation] = []
+    for path in targets:
+        if not os.path.isfile(path):
+            print(f"cannot read {path}", file=sys.stderr)
+            return 2
+        all_violations.extend(lint_file(path, rules))
+
+    for v in all_violations:
+        print(v)
+    nfiles = len(targets)
+    if all_violations:
+        print(f"\n{len(all_violations)} violation(s) in {nfiles} file(s)")
+        return 1
+    print(f"clean: {nfiles} file(s), {len(RULES) - 1} rules")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

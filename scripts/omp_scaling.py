@@ -30,8 +30,6 @@ def measure(num_nodes: int, batch: int, iters: int) -> dict:
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
-    import numpy as np
-
     import euler_tpu
     from euler_tpu.datasets import build_synthetic
 

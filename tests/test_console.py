@@ -1,7 +1,6 @@
 """Console REPL tests (reference tools/console/console.cc command surface)
 driven through Console.execute on the fixture graph."""
 
-import numpy as np
 import pytest
 
 from euler_tpu.console import Console

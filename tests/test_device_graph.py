@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 from euler_tpu.graph import device
-from tests.fixture_graph import fixture_nodes
 
 MAX_ID = 16  # fixture ids go up to 16
 
@@ -765,7 +764,6 @@ def test_supervised_gcn_device_matches_host_loss(graph):
     must produce the host path's loss (full-neighbor GCN has no sampling
     randomness)."""
     import jax
-    import jax.numpy as jnp
 
     from euler_tpu import models
 

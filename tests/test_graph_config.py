@@ -2,7 +2,6 @@
 (reference euler/client/graph.cc:163-185 NewGraph(config_file) +
 graph_config.cc:33-56 key=value loader + init=lazy)."""
 
-import numpy as np
 import pytest
 
 from euler_tpu.graph.graph import Graph, parse_config

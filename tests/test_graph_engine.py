@@ -8,7 +8,6 @@ C++ weighted-collection distribution tests
 """
 
 import numpy as np
-import pytest
 
 from tests.fixture_graph import TOPOLOGY, dense_f0
 

@@ -1,7 +1,6 @@
 """LsHNE + LasGNN tests on the heterogeneous fixture graph."""
 
 import numpy as np
-import pytest
 
 from euler_tpu import train as train_lib
 

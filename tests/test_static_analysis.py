@@ -1,0 +1,301 @@
+"""Self-gating tests for the native invariant linter (scripts/check_native.py).
+
+Two directions, so the gate can fail for either reason:
+- the clean tree stays clean — the native code cannot regress past the
+  crash-class rules the sanitizer/fuzz rounds taught us (SANITIZERS.md);
+- every rule demonstrably fires on a seeded-violation fixture — the
+  linter cannot rot into a vacuous pass.
+"""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+_SPEC = importlib.util.spec_from_file_location(
+    "check_native", REPO / "scripts" / "check_native.py"
+)
+check_native = importlib.util.module_from_spec(_SPEC)
+# dataclasses resolves the module through sys.modules when annotations are
+# postponed (PEP 563), so register before exec
+sys.modules["check_native"] = check_native
+_SPEC.loader.exec_module(check_native)
+
+
+def lint(text, name="snippet.cc", rules=None):
+    return check_native.lint_text(text, name, rules)
+
+
+def only_rule(violations, rule):
+    assert violations, f"expected a {rule} violation, linter stayed silent"
+    assert {v.rule for v in violations} == {rule}, violations
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# the clean tree is clean (and stays that way)
+# ---------------------------------------------------------------------------
+
+
+def test_native_tree_is_clean():
+    files = check_native.default_targets(str(REPO))
+    assert len(files) >= 18, files  # all .cc and .h of _native
+    violations = []
+    for f in files:
+        violations.extend(check_native.lint_file(f))
+    assert violations == [], "\n".join(map(str, violations))
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_native.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = tmp_path / "bad.cc"
+    bad.write_text("void f() {\n  mu_.lock();\n}\n")
+    dirty = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "check_native.py"), str(bad)],
+        capture_output=True,
+        text=True,
+    )
+    assert dirty.returncode == 1
+    # diagnostics carry file:line so they are jump-to-able
+    assert f"{bad}:2: [raw-lock]" in dirty.stdout
+
+
+# ---------------------------------------------------------------------------
+# each rule fires on a minimal seeded violation (file:line asserted)
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires():
+    snippet = (
+        'extern "C" {\n'
+        "int eg_boom(void* h) {\n"
+        "  return do_work(h);\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert (v.path, v.line) == ("snippet.cc", 2)
+    assert "eg_boom" in v.message
+
+
+def test_abi_barrier_accepts_guarded_function():
+    snippet = (
+        'extern "C" {\n'
+        "int eg_fine(void* h) {\n"
+        "  try {\n"
+        "    return do_work(h);\n"
+        "  } catch (...) {\n"
+        "    return -1;\n"
+        "  }\n"
+        "}\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_abi_barrier_ignores_non_extern_functions():
+    snippet = "namespace eg {\nint helper() { return 1; }\n}\n"
+    assert lint(snippet) == []
+
+
+def test_ptr_arith_bounds_fires():
+    snippet = (
+        "bool Read(const char* p, const char* end, size_t n) {\n"
+        "  if (p + n * sizeof(int) > end) return false;\n"
+        "  return true;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "ptr-arith-bounds")
+    assert (v.path, v.line) == ("snippet.cc", 2)
+
+
+def test_ptr_arith_bounds_accepts_division_form():
+    # the ByteCursor idiom: compare the count, not the advanced pointer
+    snippet = (
+        "bool Read(const char* p, const char* end, size_t n) {\n"
+        "  if (n > remaining() / sizeof(int)) return false;\n"
+        "  p += n * sizeof(int);\n"
+        "  return true;\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_thread_catch_fires_on_std_thread():
+    snippet = "void Spawn() {\n  std::thread([] { work(); }).detach();\n}\n"
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert (v.path, v.line) == ("snippet.cc", 2)
+
+
+def test_thread_catch_fires_on_thread_vector_emplace():
+    snippet = (
+        "void Fan(int n) {\n"
+        "  std::vector<std::thread> ts;\n"
+        "  for (int s = 0; s < n; ++s)\n"
+        "    ts.emplace_back([s] { work(s); });\n"
+        "  for (auto& t : ts) t.join();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 4
+
+
+def test_thread_catch_accepts_guarded_lambda():
+    snippet = (
+        "void Spawn() {\n"
+        "  std::thread([] {\n"
+        "    try {\n"
+        "      work();\n"
+        "    } catch (...) {\n"
+        "    }\n"
+        "  }).detach();\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_wire_count_alloc_fires():
+    snippet = (
+        "void Decode(WireReader* r, std::vector<int>* out) {\n"
+        "  int32_t n = r->I32();\n"
+        "  out->resize(n);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert (v.path, v.line) == ("snippet.cc", 3)
+    assert "`n`" in v.message and "line 2" in v.message
+
+
+def test_wire_count_alloc_accepts_bounded_count():
+    snippet = (
+        "void Decode(WireReader* r, std::vector<int>* out) {\n"
+        "  int32_t n = r->I32();\n"
+        "  if (n < 0 || static_cast<uint64_t>(n) > r->remaining() / 4) return;\n"
+        "  out->resize(n);\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_wire_count_alloc_fires_on_sized_vector_construction():
+    snippet = (
+        "void Handle(WireReader* r) {\n"
+        "  int32_t count = r->I32();\n"
+        "  std::vector<uint64_t> out(count);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert v.line == 3
+
+
+def test_raw_lock_fires():
+    snippet = "void Handle() {\n  mu_.lock();\n  work();\n  mu_.unlock();\n}\n"
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+def test_raw_lock_accepts_raii_guard():
+    snippet = (
+        "void Handle() {\n"
+        "  std::lock_guard<std::mutex> l(mu_);\n"
+        "  work();\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_thread_rng_fires():
+    snippet = "int Draw() {\n  srand(42);\n  return rand() % 10;\n}\n"
+    violations = only_rule(lint(snippet), "thread-rng")
+    assert [v.line for v in violations] == [2, 3]
+
+
+def test_thread_rng_accepts_thread_rng():
+    snippet = "int Draw() {\n  return ThreadRng().NextLess(10);\n}\n"
+    assert lint(snippet) == []
+
+
+# ---------------------------------------------------------------------------
+# the escape hatch: visible, reasoned, typo-proof
+# ---------------------------------------------------------------------------
+
+
+def test_allow_escape_suppresses_with_reason():
+    snippet = (
+        "void Decode(WireReader* r, std::vector<int>* out) {\n"
+        "  int32_t n = r->I32();\n"
+        "  // eg-lint: allow(wire-count-alloc) bounded by caller contract\n"
+        "  out->resize(n);\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_allow_escape_on_same_line():
+    snippet = (
+        "void Handle() {\n"
+        "  mu_.lock();  // eg-lint: allow(raw-lock) handing off to C callback\n"
+        "}\n"
+    )
+    assert lint(snippet) == []
+
+
+def test_allow_escape_without_reason_is_a_violation():
+    snippet = (
+        "void Handle() {\n"
+        "  // eg-lint: allow(raw-lock)\n"
+        "  mu_.lock();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "allow-escape")
+    assert "no reason" in v.message
+
+
+def test_allow_escape_for_wrong_rule_does_not_suppress():
+    snippet = (
+        "void Handle() {\n"
+        "  // eg-lint: allow(thread-rng) wrong rule named here\n"
+        "  mu_.lock();\n"
+        "}\n"
+    )
+    rules = {v.rule for v in lint(snippet)}
+    assert "raw-lock" in rules
+
+
+def test_allow_escape_unknown_rule_is_a_violation():
+    snippet = "void f() {\n  // eg-lint: allow(not-a-rule) whatever\n  g();\n}\n"
+    (v,) = only_rule(lint(snippet), "allow-escape")
+    assert "unknown rule" in v.message
+
+
+# ---------------------------------------------------------------------------
+# regression pins: the exact crash classes from SANITIZERS.md stay caught
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "line",
+    [
+        "if (p_ + n * sizeof(T) > end_) return false;",
+        "if (end_ < p_ + n * sizeof(T)) return false;",
+        "while (cur + count * sizeof(uint64_t) <= limit) step();",
+    ],
+)
+def test_round2_bounds_crash_class_variants(line):
+    snippet = f"bool F(size_t n) {{\n  {line}\n  return true;\n}}\n"
+    only_rule(lint(snippet), "ptr-arith-bounds")
+
+
+def test_rules_are_individually_selectable():
+    snippet = "void Handle() {\n  mu_.lock();\n  srand(1);\n}\n"
+    assert {v.rule for v in lint(snippet)} == {"raw-lock", "thread-rng"}
+    assert {v.rule for v in lint(snippet, rules=["raw-lock"])} == {"raw-lock"}
