@@ -27,12 +27,21 @@ if _os.environ.get("JAX_PLATFORMS"):
 
 from euler_tpu.graph.graph import Graph
 from euler_tpu.graph.convert import convert, convert_dicts
-from euler_tpu.graph.native import stats, stats_reset
+from euler_tpu.graph.native import (
+    counters,
+    counters_reset,
+    fault_clear,
+    fault_config,
+    fault_injected,
+    stats,
+    stats_reset,
+)
 from euler_tpu.graph.service import GraphService
 
 __version__ = "0.2.0"
 
 __all__ = [
     "Graph", "GraphService", "convert", "convert_dicts", "stats",
-    "stats_reset",
+    "stats_reset", "counters", "counters_reset", "fault_config",
+    "fault_clear", "fault_injected",
 ]

@@ -246,21 +246,32 @@ class Console:
             print(" -> ".join(str(int(x)) for x in row))
 
     def do_stats(self, args: list) -> None:
-        from euler_tpu.graph.native import stats, stats_reset
+        from euler_tpu.graph.native import (
+            counters,
+            counters_reset,
+            stats,
+            stats_reset,
+        )
 
         if args and args[0] == "reset":
             stats_reset()
+            counters_reset()
             print("stats reset")
             return
         snap = stats()
         if not snap:
             print("no ops recorded")
-            return
-        print(f"{'op':16s} {'count':>10s} {'total_ms':>10s} "
-              f"{'avg_us':>10s} {'max_us':>10s}")
-        for name, s in sorted(snap.items()):
-            print(f"{name:16s} {s['count']:10d} {s['total_ms']:10.2f} "
-                  f"{s['avg_us']:10.2f} {s['max_us']:10.2f}")
+        else:
+            print(f"{'op':16s} {'count':>10s} {'total_ms':>10s} "
+                  f"{'avg_us':>10s} {'max_us':>10s}")
+            for name, s in sorted(snap.items()):
+                print(f"{name:16s} {s['count']:10d} {s['total_ms']:10.2f} "
+                      f"{s['avg_us']:10.2f} {s['max_us']:10.2f}")
+        fails = {k: v for k, v in counters().items() if v}
+        if fails:
+            print("failures:")
+            for name, v in sorted(fails.items()):
+                print(f"  {name:20s} {v:10d}")
 
     def execute(self, line: str) -> bool:
         """Run one command line; returns False on quit."""

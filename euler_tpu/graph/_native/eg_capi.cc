@@ -13,6 +13,7 @@
 #include <vector>
 
 #include "eg_engine.h"
+#include "eg_fault.h"
 #include "eg_registry.h"
 #include "eg_sampling.h"
 #include "eg_stats.h"
@@ -561,6 +562,83 @@ void eg_stats_snapshot(uint64_t* counts, uint64_t* total_ns,
 void eg_stats_reset() {
   try {
     eg::Stats::Global().Reset();
+  }
+  EG_API_GUARD()
+}
+
+// ---- failure counters (eg_stats.h Counters: transport retries,
+// quarantines, failovers, deadline aborts, rejected frames, ...) ----
+int eg_counter_count() {
+  try {
+    return eg::kCtrCount;
+  }
+  EG_API_GUARD(0)
+}
+
+const char* eg_counter_name(int i) {
+  try {
+    return (i >= 0 && i < eg::kCtrCount) ? eg::kCounterNames[i] : "";
+  }
+  EG_API_GUARD("")
+}
+
+// out sized eg_counter_count().
+void eg_counters_snapshot(uint64_t* out) {
+  try {
+    eg::Counters::Global().Snapshot(out);
+  }
+  EG_API_GUARD()
+}
+
+void eg_counters_reset() {
+  try {
+    eg::Counters::Global().Reset();
+  }
+  EG_API_GUARD()
+}
+
+// ---- deterministic failpoints (eg_fault.h; FAULTS.md) ----
+// Install a process-global fault spec, e.g.
+// "recv_frame:err@0.5,dial:delay@200"; seed makes the per-point failure
+// sequences replayable. Empty/NULL spec clears. -1 + eg_last_error on a
+// malformed spec (nothing installed).
+int eg_fault_config(const char* spec, uint64_t seed) {
+  try {
+    if (!eg::FaultInjector::Global().Configure(spec ? spec : "", seed)) {
+      g_last_error = eg::FaultInjector::Global().error();
+      return -1;
+    }
+    return 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+void eg_fault_clear() {
+  try {
+    eg::FaultInjector::Global().Clear();
+  }
+  EG_API_GUARD()
+}
+
+int eg_fault_count() {
+  try {
+    return eg::kFaultIdCount;
+  }
+  EG_API_GUARD(0)
+}
+
+const char* eg_fault_name(int i) {
+  try {
+    return (i >= 0 && i < eg::kFaultIdCount) ? eg::kFaultNames[i] : "";
+  }
+  EG_API_GUARD("")
+}
+
+// Injected-fault ledger: fires per failpoint since its last (re)config.
+// out sized eg_fault_count().
+void eg_fault_injected(uint64_t* out) {
+  try {
+    eg::FaultInjector::Global().SnapshotInjected(out);
   }
   EG_API_GUARD()
 }

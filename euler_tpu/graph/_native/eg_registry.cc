@@ -10,6 +10,7 @@
 #include <cstring>
 #include <sstream>
 
+#include "eg_fault.h"
 #include "eg_wire.h"
 
 namespace eg {
@@ -84,6 +85,10 @@ void RegistryServer::HandleConn(int fd) {
   std::string req;
   while (!stopping_ && RecvFrame(fd, &req)) {
     std::string reply = Dispatch(req);
+    // kFaultRegistryReply: the REG/LIST was processed but its reply is
+    // lost — registrants must treat it as a missed heartbeat and redial,
+    // clients as a failed discovery pass.
+    if (FaultHit(kFaultRegistryReply)) break;
     if (!SendFrame(fd, reply)) break;
   }
 }

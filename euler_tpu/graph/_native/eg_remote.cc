@@ -11,7 +11,9 @@
 #include <sstream>
 #include <thread>
 
+#include "eg_fault.h"
 #include "eg_registry.h"
+#include "eg_stats.h"
 
 namespace eg {
 
@@ -51,7 +53,7 @@ bool ParseHostPort(const std::string& s, std::string* host, int* port) {
 // reject it before the resize below turns a hostile count from a
 // malformed reply into a multi-GB zero-fill (the round-2 service crash
 // class, service-side fix in OversizedResult; this is the client side).
-bool ReadResult(WireReader* r, EGResult* out) {
+bool ReadResultBody(WireReader* r, EGResult* out) {
   int32_t n = r->I32();
   if (n < 0 || static_cast<uint64_t>(n) > r->remaining() / 8) return false;
   out->u64.resize(n);
@@ -69,6 +71,12 @@ bool ReadResult(WireReader* r, EGResult* out) {
   out->bytes.resize(n);
   for (auto& s : out->bytes) s = r->Str();
   return r->ok();
+}
+
+bool ReadResult(WireReader* r, EGResult* out) {
+  if (ReadResultBody(r, out)) return true;
+  Counters::Global().Add(kCtrFrameReject);
+  return false;
 }
 
 }  // namespace
@@ -118,7 +126,8 @@ size_t ConnPool::num_replicas() const {
 }
 
 bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
-                    int timeout_ms, int quarantine_ms) const {
+                    int timeout_ms, int quarantine_ms, int backoff_ms,
+                    int deadline_ms) const {
   // snapshot: Update() may swap the set mid-call; shared_ptrs keep every
   // replica this exchange touches alive
   std::vector<std::shared_ptr<Replica>> reps;
@@ -127,8 +136,42 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
     reps = replicas_;
   }
   if (reps.empty()) return false;
-  int64_t now = NowMs();
+  Counters& ctr = Counters::Global();
+  // Overall wall-clock budget spanning every attempt; the 0 default keeps
+  // the previous worst case (each attempt bounded by timeout_ms).
+  const int64_t deadline =
+      NowMs() + (deadline_ms > 0
+                     ? deadline_ms
+                     : static_cast<int64_t>(timeout_ms) * (retries + 1));
+  bool failed_before = false;
   for (int attempt = 0; attempt <= retries; ++attempt) {
+    // Re-sample the clock each attempt: a slow earlier attempt must age
+    // quarantine verdicts and count against the deadline (the old single
+    // pre-loop NowMs() went stale across attempts).
+    int64_t now = NowMs();
+    if (attempt > 0) {
+      ctr.Add(kCtrRetry);
+      // Exponential backoff with full jitter: sleep uniform in
+      // [0, base << (attempt-1)], capped at 2 s and at the remaining
+      // deadline — a hot retry loop against a struggling shard is a
+      // self-inflicted DDoS.
+      int64_t cap = std::min<int64_t>(
+          static_cast<int64_t>(backoff_ms) << std::min(attempt - 1, 16),
+          2000);
+      int64_t sleep_ms = cap > 0
+                             ? static_cast<int64_t>(ThreadRng().NextLess(
+                                   static_cast<uint64_t>(cap) + 1))
+                             : 0;
+      sleep_ms = std::min(sleep_ms, deadline - now);
+      if (sleep_ms > 0) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+        now = NowMs();
+      }
+      if (now >= deadline) {
+        ctr.Add(kCtrDeadlineExceeded);
+        break;
+      }
+    }
     // Round-robin replica choice skipping quarantined hosts; if every host
     // is quarantined, use the nominal one anyway (matches the reference's
     // bad-host re-admission behavior, rpc_manager.cc:64).
@@ -151,17 +194,24 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
     }
     if (fd < 0) fd = DialTcp(rep->host, rep->port, timeout_ms);
     if (fd < 0) {
+      ctr.Add(kCtrDialFail);
+      ctr.Add(kCtrQuarantine);
       rep->bad_until_ms.store(now + quarantine_ms, std::memory_order_relaxed);
+      failed_before = true;
       continue;
     }
     if (SendFrame(fd, req) && RecvFrame(fd, reply)) {
+      if (failed_before) ctr.Add(kCtrFailover);
       std::lock_guard<std::mutex> l(rep->mu);
       rep->idle.push_back(fd);
       return true;
     }
     ::close(fd);
+    ctr.Add(kCtrQuarantine);
     rep->bad_until_ms.store(now + quarantine_ms, std::memory_order_relaxed);
+    failed_before = true;
   }
+  ctr.Add(kCtrCallFail);
   return false;
 }
 
@@ -240,6 +290,7 @@ void RemoteGraph::RediscoverLoop() {
       auto it = shards.find(s);
       if (it != shards.end()) pools_[s].Update(it->second);
     }
+    Counters::Global().Add(kCtrRediscover);
   }
 }
 
@@ -249,6 +300,20 @@ bool RemoteGraph::Init(const std::string& config) {
   if (cfg.count("timeout_ms")) timeout_ms_ = std::stoi(cfg["timeout_ms"]);
   if (cfg.count("quarantine_ms"))
     quarantine_ms_ = std::stoi(cfg["quarantine_ms"]);
+  if (cfg.count("backoff_ms")) backoff_ms_ = std::stoi(cfg["backoff_ms"]);
+  if (cfg.count("deadline_ms")) deadline_ms_ = std::stoi(cfg["deadline_ms"]);
+
+  // Deterministic transport failpoints (eg_fault.h). Installed BEFORE the
+  // per-shard kInfo fetches below, so even Init's own calls replay under
+  // the configured faults — the seed owns the whole session.
+  if (cfg.count("fault")) {
+    uint64_t fseed = 0;
+    if (cfg.count("fault_seed")) fseed = std::stoull(cfg["fault_seed"]);
+    if (!FaultInjector::Global().Configure(cfg["fault"], fseed)) {
+      error_ = FaultInjector::Global().error();
+      return false;
+    }
+  }
 
   // shard -> replica address list
   std::map<int, std::vector<std::pair<std::string, int>>> shards;
@@ -412,9 +477,16 @@ void RemoteGraph::TypeWeightSums(int kind, float* out) const {
 
 bool RemoteGraph::Call(int shard, const std::string& req,
                        std::string* reply) const {
-  if (!pools_[shard].Call(req, reply, retries_, timeout_ms_, quarantine_ms_))
+  if (!pools_[shard].Call(req, reply, retries_, timeout_ms_, quarantine_ms_,
+                          backoff_ms_, deadline_ms_))
     return false;
-  return !reply->empty() && (*reply)[0] == 0;
+  if (reply->empty() || (*reply)[0] != 0) {
+    // transport delivered a frame, but the shard refused the request —
+    // visible in the ledger as a rejected frame, not a silent default
+    Counters::Global().Add(kCtrFrameReject);
+    return false;
+  }
+  return true;
 }
 
 void RemoteGraph::GroupByShard(const uint64_t* ids, int n,

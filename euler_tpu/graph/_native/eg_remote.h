@@ -63,10 +63,18 @@ class ConnPool {
 
   size_t num_replicas() const;
 
-  // One request/reply exchange; retries across replicas. Returns false when
-  // every attempt failed (reply undefined).
+  // One request/reply exchange; retries across replicas with exponential
+  // backoff (full jitter, base backoff_ms, capped at 2 s) between
+  // attempts and an overall deadline spanning all of them (deadline_ms;
+  // 0 = timeout_ms * (retries + 1), the previous worst case). The clock
+  // is re-sampled per attempt so quarantine verdicts and the deadline see
+  // time spent in earlier attempts. Returns false when every attempt
+  // failed or the deadline expired (reply undefined). Failure counters
+  // (eg_stats.h Counters) record dial failures, retries, quarantines,
+  // failovers, deadline aborts, and exhausted calls.
   bool Call(const std::string& req, std::string* reply, int retries,
-            int timeout_ms, int quarantine_ms) const;
+            int timeout_ms, int quarantine_ms, int backoff_ms = 20,
+            int deadline_ms = 0) const;
 
  private:
   mutable std::mutex mu_;  // guards replicas_ (the vector, not the pools)
@@ -82,6 +90,12 @@ class RemoteGraph : public GraphAPI {
   //   shards=<h:p|h:p,...>  explicit per-shard replica lists
   //                         (',' separates shards, '|' separates replicas)
   //   retries (default 3), timeout_ms (5000), quarantine_ms (3000),
+  //   backoff_ms (20): base of the exponential retry backoff (full
+  //   jitter, doubling per attempt, capped at 2 s; 0 = no backoff),
+  //   deadline_ms (0 = timeout_ms * (retries + 1)): overall wall-clock
+  //   budget of ONE Call spanning all of its retry attempts,
+  //   fault= / fault_seed=: deterministic transport failpoints
+  //   (process-global FaultInjector, see eg_fault.h and FAULTS.md),
   //   rediscover_ms (default 3000 with registry=, 0 = off): period of the
   //   background registry re-LIST that diffs shard addresses into the
   //   ConnPools — the reference's ZK watch-children semantics
@@ -197,6 +211,7 @@ class RemoteGraph : public GraphAPI {
   std::string error_;
   int num_shards_ = 0, num_partitions_ = 1;
   int retries_ = 3, timeout_ms_ = 5000, quarantine_ms_ = 3000;
+  int backoff_ms_ = 20, deadline_ms_ = 0;
 
   // discovery source recorded by Init for the periodic re-LIST
   // (empty reg_host_ AND empty reg_dir_ = static shards=, no re-discovery)

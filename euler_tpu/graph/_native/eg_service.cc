@@ -9,6 +9,7 @@
 #include <cstdio>
 #include <fstream>
 
+#include "eg_fault.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
 #include "eg_wire.h"
@@ -119,7 +120,12 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
                slept += 50)
             std::this_thread::sleep_for(std::chrono::milliseconds(50));
           if (heartbeat_stop_) break;
-          if (fd < 0 || !RegistrySend(fd, line, &ttl_ms)) {
+          // kFaultHeartbeat forces this beat to miss: the held connection
+          // is dropped and the redial path below must keep the registry
+          // entry alive — exactly what a blipped registry link exercises.
+          if (FaultHit(kFaultHeartbeat) || fd < 0 ||
+              !RegistrySend(fd, line, &ttl_ms)) {
+            Counters::Global().Add(kCtrHeartbeatMiss);
             if (fd >= 0) ::close(fd);
             fd = DialTcp(reg_host_, reg_port_, 2000);
             if (fd >= 0) RegistrySend(fd, line, &ttl_ms);
@@ -229,6 +235,10 @@ void Service::HandleConn(int fd) {
       e.Str(std::string("server error: ") + ex.what());
       reply = std::move(e.buf());
     }
+    // kFaultServiceReply drops the computed reply on the floor and closes
+    // the connection — the client sees a mid-exchange reset and must
+    // retry (possibly re-running the request on another replica).
+    if (FaultHit(kFaultServiceReply)) break;
     if (!SendFrame(fd, reply)) break;
   }
 }

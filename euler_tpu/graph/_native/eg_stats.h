@@ -83,6 +83,60 @@ class Stats {
   Cell cells_[kStatOpCount];
 };
 
+// Failure counters for the remote transport: where the span timers above
+// measure how long the sampling tier takes, these count how often it has
+// to fight for an answer (retries, quarantines, failovers, deadline
+// aborts, rejected frames, registry churn). Same mechanism — relaxed
+// atomics recorded at the choke points, snapshot into Python through the
+// stats surface — so a production run and the chaos soak (FAULTS.md)
+// read identical ledgers.
+enum CounterId : int {
+  kCtrDialFail = 0,      // DialTcp failed inside ConnPool::Call
+  kCtrRetry,             // attempts beyond the first within one Call
+  kCtrQuarantine,        // a replica marked bad (timed quarantine)
+  kCtrFailover,          // a Call that succeeded after >=1 failed attempt
+  kCtrCallFail,          // a Call that exhausted retries/deadline
+  kCtrDeadlineExceeded,  // a Call aborted by its overall deadline
+  kCtrFrameReject,       // oversize/malformed/error-status frame rejected
+  kCtrRediscover,        // background registry re-LIST applied to pools
+  kCtrHeartbeatMiss,     // a service registry heartbeat that had to redial
+  kCtrCount,
+};
+
+const char* const kCounterNames[kCtrCount] = {
+    "dials_failed",       "retries",          "quarantines",
+    "failovers",          "calls_failed",     "deadlines_exceeded",
+    "frames_rejected",    "rediscoveries",    "heartbeat_misses",
+};
+
+class Counters {
+ public:
+  static Counters& Global() {
+    static Counters c;
+    return c;
+  }
+
+  void Add(CounterId id, uint64_t n = 1) {
+    cells_[id].fetch_add(n, std::memory_order_relaxed);
+  }
+
+  uint64_t Get(CounterId id) const {
+    return cells_[id].load(std::memory_order_relaxed);
+  }
+
+  void Snapshot(uint64_t* out) const {
+    for (int i = 0; i < kCtrCount; ++i)
+      out[i] = cells_[i].load(std::memory_order_relaxed);
+  }
+
+  void Reset() {
+    for (auto& c : cells_) c.store(0, std::memory_order_relaxed);
+  }
+
+ private:
+  std::atomic<uint64_t> cells_[kCtrCount]{};
+};
+
 // RAII span: records wall time from construction to destruction.
 class SpanTimer {
  public:

@@ -11,6 +11,9 @@
 #include <cerrno>
 #include <cstring>
 
+#include "eg_fault.h"
+#include "eg_stats.h"
+
 namespace eg {
 
 namespace {
@@ -54,6 +57,7 @@ void SetTimeouts(int fd, int timeout_ms) {
 }  // namespace
 
 bool SendFrame(int fd, const std::string& payload) {
+  if (FaultHit(kFaultSendFrame)) return false;
   uint32_t len = static_cast<uint32_t>(payload.size());
   if (payload.size() > kMaxFrame) return false;
   char hdr[4];
@@ -64,14 +68,24 @@ bool SendFrame(int fd, const std::string& payload) {
 bool RecvFrame(int fd, std::string* payload) {
   char hdr[4];
   if (!ReadAll(fd, hdr, 4)) return false;
+  // Fires after the header — a frame demonstrably began arriving — so an
+  // injected fault is a true mid-frame reset (bytes lost, connection
+  // must be discarded). Deliberately NOT at entry: a server handler
+  // parked between requests would otherwise draw from the stream while
+  // idle, making fault accounting depend on scheduler timing.
+  if (FaultHit(kFaultRecvFrame)) return false;
   uint32_t len;
   std::memcpy(&len, hdr, 4);
-  if (len > kMaxFrame) return false;
+  if (len > kMaxFrame) {
+    Counters::Global().Add(kCtrFrameReject);
+    return false;
+  }
   payload->resize(len);
   return len == 0 || ReadAll(fd, payload->data(), len);
 }
 
 int DialTcp(const std::string& host, int port, int timeout_ms) {
+  if (FaultHit(kFaultDial)) return -1;
   addrinfo hints{};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;

@@ -120,6 +120,10 @@ class Graph:
         timeout_ms: int | None = None,
         quarantine_ms: int | None = None,
         rediscover_ms: int | None = None,
+        backoff_ms: int | None = None,
+        deadline_ms: int | None = None,
+        fault: str | None = None,
+        fault_seed: int | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
         config: str | None = None,
@@ -135,7 +139,8 @@ class Graph:
         known = {
             "directory", "files", "shard_idx", "shard_num", "mode",
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
-            "rediscover_ms", "cache_dir", "stream", "init",
+            "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
+            "fault_seed", "cache_dir", "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -166,6 +171,15 @@ class Graph:
         # mid-run registry re-LIST period (native RediscoverLoop); None =
         # the native default (3000 ms with a registry, off for shards=)
         rediscover_ms = pick("rediscover_ms", rediscover_ms, None)
+        # retry pacing (native ConnPool::Call): base of the jittered
+        # exponential backoff, and the overall per-call deadline spanning
+        # all retries; None = native defaults (20 ms / timeout*(retries+1))
+        backoff_ms = pick("backoff_ms", backoff_ms, None)
+        deadline_ms = pick("deadline_ms", deadline_ms, None)
+        # deterministic transport failpoints (FAULTS.md), e.g.
+        # "recv_frame:err@0.5,dial:delay@200"; process-global
+        fault = pick("fault", fault, None)
+        fault_seed = pick("fault_seed", fault_seed, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
@@ -173,6 +187,28 @@ class Graph:
         init = str(pick("init", init, "eager")).lower()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
+        if directory is not None and files:
+            # never dropped silently: the load dispatch would consume
+            # directory= and ignore the file list entirely
+            raise ValueError(
+                "pass directory= OR files=, not both (the embedded "
+                "engine loads exactly one of them; a files= list next "
+                "to directory= would be silently ignored)"
+            )
+        if fault_seed is not None and fault is None:
+            raise ValueError(
+                "fault_seed= without fault= would seed nothing — pass the "
+                "failpoint spec too (FAULTS.md)"
+            )
+        if fault is not None and mode != "remote":
+            # the failpoints live in the TCP transport; accepting the key
+            # on a local graph would just mislead (nothing would fire)
+            raise ValueError(
+                "fault= applies to mode='remote' graphs (failpoints sit "
+                "in the transport, see FAULTS.md; for service-side "
+                "injection use euler_tpu.graph.native.fault_config in "
+                "the shard process)"
+            )
         if stream and mode != "local":
             # never dropped silently: remote mode reads no graph data
             # itself, so accepting the flag would just mislead
@@ -188,6 +224,8 @@ class Graph:
             shard_num=shard_num, registry=registry, shards=shards,
             retries=retries, timeout_ms=timeout_ms,
             quarantine_ms=quarantine_ms, rediscover_ms=rediscover_ms,
+            backoff_ms=backoff_ms, deadline_ms=deadline_ms,
+            fault=fault, fault_seed=fault_seed,
             cache_dir=cache_dir, stream=bool(stream),
         )
         self.mode = mode
@@ -295,6 +333,16 @@ class Graph:
             )
             if p["rediscover_ms"] is not None:
                 conf += f";rediscover_ms={int(p['rediscover_ms'])}"
+            if p["backoff_ms"] is not None:
+                conf += f";backoff_ms={int(p['backoff_ms'])}"
+            if p["deadline_ms"] is not None:
+                conf += f";deadline_ms={int(p['deadline_ms'])}"
+            if p["fault"] is not None:
+                # ';' is the k=v separator, so the fault grammar uses ','
+                # between failpoints (FAULTS.md)
+                conf += f";fault={p['fault']}"
+                if p["fault_seed"] is not None:
+                    conf += f";fault_seed={int(p['fault_seed'])}"
             self._handle = self._lib.eg_remote_create(conf.encode())
             if not self._handle:
                 self._handle = None

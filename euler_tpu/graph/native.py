@@ -72,6 +72,15 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_stat_name, c.c_char_p, [c.c_int])
     _sig(L.eg_stats_snapshot, None, [u64p, u64p, u64p])
     _sig(L.eg_stats_reset, None, [])
+    _sig(L.eg_counter_count, c.c_int, [])
+    _sig(L.eg_counter_name, c.c_char_p, [c.c_int])
+    _sig(L.eg_counters_snapshot, None, [u64p])
+    _sig(L.eg_counters_reset, None, [])
+    _sig(L.eg_fault_config, c.c_int, [c.c_char_p, c.c_uint64])
+    _sig(L.eg_fault_clear, None, [])
+    _sig(L.eg_fault_count, c.c_int, [])
+    _sig(L.eg_fault_name, c.c_char_p, [c.c_int])
+    _sig(L.eg_fault_injected, None, [u64p])
     _sig(L.eg_remote_create, p, [c.c_char_p])
     _sig(L.eg_remote_shards, c.c_int, [p])
     _sig(L.eg_remote_partitions, c.c_int, [p])
@@ -196,3 +205,51 @@ def stats() -> dict:
 def stats_reset() -> None:
     """Zero the native span-timer accumulators."""
     lib().eg_stats_reset()
+
+
+def counters() -> dict:
+    """Snapshot of the native failure counters (process-global, see
+    _native/eg_stats.h Counters): how often the remote transport had to
+    fight for an answer — {"dials_failed": n, "retries": n,
+    "quarantines": n, "failovers": n, "calls_failed": n,
+    "deadlines_exceeded": n, "frames_rejected": n, "rediscoveries": n,
+    "heartbeat_misses": n}. All keys always present (zero included), so
+    dashboards and the chaos soak can diff snapshots without key
+    existence checks."""
+    L = lib()
+    n = L.eg_counter_count()
+    arr = (ctypes.c_uint64 * n)()
+    L.eg_counters_snapshot(arr)
+    return {L.eg_counter_name(i).decode(): int(arr[i]) for i in range(n)}
+
+
+def counters_reset() -> None:
+    """Zero the native failure counters."""
+    lib().eg_counters_reset()
+
+
+def fault_config(spec: str, seed: int = 0) -> None:
+    """Install a process-global deterministic failpoint spec (FAULTS.md),
+    e.g. ``recv_frame:err@0.5,dial:delay@200``. ``seed`` makes each
+    failpoint's failure sequence replayable: the same seed fires the
+    same pattern of faults at each point. Raises ValueError on a
+    malformed spec (nothing installed). An empty spec clears."""
+    rc = lib().eg_fault_config(spec.encode(), seed)
+    if rc != 0:
+        raise ValueError(lib().eg_last_error().decode())
+
+
+def fault_clear() -> None:
+    """Remove every installed failpoint (back to the zero-cost path)."""
+    lib().eg_fault_clear()
+
+
+def fault_injected() -> dict:
+    """Injected-fault ledger: {failpoint: fires since its last config},
+    all failpoints always present — the ground truth the failure
+    counters are audited against in the chaos soak."""
+    L = lib()
+    n = L.eg_fault_count()
+    arr = (ctypes.c_uint64 * n)()
+    L.eg_fault_injected(arr)
+    return {L.eg_fault_name(i).decode(): int(arr[i]) for i in range(n)}

@@ -218,13 +218,30 @@ def read_directory(
     return [(os.path.basename(p), b) for p, b in zip(names, blobs)]
 
 
+def _reject_duplicates(urls: list[str]) -> None:
+    """Duplicate URLs in an explicit file list must fail loudly here:
+    they would reach the native name-sorted merge as equal keys, where
+    std::sort leaves their relative order unspecified — the built store
+    would differ run to run with no hint why."""
+    seen: set[str] = set()
+    dups = sorted({u for u in urls if u in seen or seen.add(u)})
+    if dups:
+        raise ValueError(
+            f"duplicate file URL(s) in files=: {dups} (the native merge "
+            "sorts by name, so every name must be unique for a "
+            "deterministic store)"
+        )
+
+
 def read_files(urls: list[str]) -> list[tuple[str, bytes]]:
     """Streamed counterpart of stage_files: fetch each file's bytes —
     remote via fsspec, local straight off disk — with no staging copy.
     The full URL/path is the returned name (basenames in an explicit
     file list can collide, and the native merge sorts by name, so names
-    must be unique for the order to be deterministic).
+    must be unique for the order to be deterministic; duplicates raise).
     """
+    _reject_duplicates(urls)
+
     def fetch_one(url: str) -> tuple[str, bytes]:
         if is_remote_path(url):
             fs, path = _filesystem(url)
@@ -249,7 +266,9 @@ def stage_files(
     cache_dir: str | None = None,
     refresh: bool = False,
 ) -> list[str]:
-    """Stage an explicit file list; local paths pass through untouched."""
+    """Stage an explicit file list; local paths pass through untouched.
+    Duplicate URLs raise, for the same determinism reason as read_files."""
+    _reject_duplicates(urls)
     out = []
     for url in urls:
         if not is_remote_path(url):

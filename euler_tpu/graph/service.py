@@ -96,7 +96,15 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--registry", default=None)
+    ap.add_argument("--fault", default="", help=(
+        "deterministic failpoint spec injected in THIS shard process "
+        "(service_reply/recv_frame/heartbeat/... — see FAULTS.md)"))
+    ap.add_argument("--fault_seed", type=int, default=0)
     args = ap.parse_args()
+    if args.fault:
+        from euler_tpu.graph.native import fault_config
+
+        fault_config(args.fault, args.fault_seed)
     svc = GraphService(
         args.data_dir,
         args.shard_idx,

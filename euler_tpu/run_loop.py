@@ -69,6 +69,19 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "mid-run registry re-LIST period for remote/shared clients "
         "(default: native 3000 ms with a registry; 0 disables) — how a "
         "shard restarted on a new address is re-learned mid-training"))
+    p.add_argument("--backoff_ms", type=int, default=None, help=(
+        "base of the jittered exponential retry backoff in the remote "
+        "client (default: native 20 ms; 0 = hot retry)"))
+    p.add_argument("--deadline_ms", type=int, default=None, help=(
+        "overall wall-clock budget of ONE graph call spanning all its "
+        "retries (default: timeout_ms * (retries+1))"))
+    p.add_argument("--fault", default="", help=(
+        "deterministic transport failpoint spec for chaos drills, e.g. "
+        "'recv_frame:err@0.1,dial:delay@50' (remote/shared modes; see "
+        "FAULTS.md)"))
+    p.add_argument("--fault_seed", type=int, default=0, help=(
+        "seed for --fault: the same seed replays the same injected-"
+        "failure sequence at every failpoint"))
     p.add_argument("--service_host", default="", help=(
         "address this process's graph shard binds and advertises "
         "(shared mode). Empty = auto: the interface that routes to a "
@@ -187,6 +200,13 @@ def build_graph(args):
             "(shared/remote services stage their shard to the local "
             "cache; see DEPLOY.md 'Remote data')"
         )
+    if args.fault and args.graph_mode == "local":
+        # same loudness rule as --stream: the failpoints live in the TCP
+        # transport, so on a local graph the flag would silently do nothing
+        raise ValueError(
+            "--fault needs --graph_mode=remote or shared (failpoints sit "
+            "in the transport; see FAULTS.md)"
+        )
     if args.graph_mode == "local":
         graph = euler_tpu.Graph(
             directory=args.data_dir, stream=args.stream
@@ -197,6 +217,10 @@ def build_graph(args):
             registry=args.registry or None,
             shards=args.shards.split(",") if args.shards else None,
             rediscover_ms=args.rediscover_ms,
+            backoff_ms=args.backoff_ms,
+            deadline_ms=args.deadline_ms,
+            fault=args.fault or None,
+            fault_seed=args.fault_seed if args.fault else None,
         )
     else:  # shared: serve this process's shard, then connect remote
         if not args.registry:
@@ -320,6 +344,10 @@ def build_graph(args):
         graph = euler_tpu.Graph(
             mode="remote", registry=args.registry,
             rediscover_ms=args.rediscover_ms,
+            backoff_ms=args.backoff_ms,
+            deadline_ms=args.deadline_ms,
+            fault=args.fault or None,
+            fault_seed=args.fault_seed if args.fault else None,
         )
     return graph, services
 

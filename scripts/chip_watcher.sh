@@ -14,31 +14,60 @@
 #
 # With no CMD, runs the full evidence queue (EULER_TPU_SWEEP=1
 # scripts/tpu_checks.sh). Every probe is timestamped so the log doubles
-# as the relay-availability record for the session.
+# as the relay-availability record for the session. The interpreter is
+# ${PYTHON:-python3}; a probe that fails to LAUNCH (missing interpreter,
+# import error) is fatal, not logged as "relay still wedged" — a watcher
+# that can never probe must die loudly instead of looping forever.
 #   sh scripts/chip_watcher.sh [-i seconds] [cmd...]
 cd "$(dirname "$0")/.." || exit 1
+PYTHON="${PYTHON:-python3}"
 INTERVAL=240
 if [ "$1" = "-i" ]; then
+  if [ -z "$2" ]; then
+    echo "chip_watcher: -i needs a seconds argument" >&2
+    exit 2
+  fi
+  case "$2" in
+    ''|*[!0-9]*)
+      echo "chip_watcher: -i wants a positive integer, got '$2'" >&2
+      exit 2
+      ;;
+  esac
   INTERVAL="$2"
   shift 2
 fi
 
 while :; do
-  if timeout -k 10 170 python -c "
+  # probe exit codes: 0 = window open, 3 = relay down (probe RAN and said
+  # no), 124/137 = probe timed out (wedged relay). Anything else means the
+  # probe itself could not run (127 interpreter missing, 1 import error,
+  # ...) — fatal, because retrying would log "wedged" forever while never
+  # actually probing.
+  timeout -k 10 170 "$PYTHON" -c "
 import sys
 from euler_tpu.parallel import probe_backend_once
 p, err = probe_backend_once(150)
 print('probe:', p or err, flush=True)
-sys.exit(0 if p else 1)
-"; then
-    echo "chip_watcher: probe succeeded at $(date -u +%H:%M:%S) — running queue" >&2
-    if [ "$#" -gt 0 ]; then
-      "$@"
-    else
-      EULER_TPU_SWEEP=1 sh scripts/tpu_checks.sh
-    fi
-    exit $?
-  fi
-  echo "chip_watcher: $(date -u +%H:%M:%S) relay still wedged; next probe in ${INTERVAL}s" >&2
+sys.exit(0 if p else 3)
+"
+  rc=$?
+  case "$rc" in
+    0)
+      echo "chip_watcher: probe succeeded at $(date -u +%H:%M:%S) — running queue" >&2
+      if [ "$#" -gt 0 ]; then
+        "$@"
+      else
+        EULER_TPU_SWEEP=1 sh scripts/tpu_checks.sh
+      fi
+      exit $?
+      ;;
+    3|124|137)
+      echo "chip_watcher: $(date -u +%H:%M:%S) relay still wedged (rc=$rc); next probe in ${INTERVAL}s" >&2
+      ;;
+    *)
+      echo "chip_watcher: probe failed to launch (rc=$rc, interpreter=$PYTHON) — fix the environment, not the relay" >&2
+      exit 1
+      ;;
+  esac
   sleep "$INTERVAL"
 done

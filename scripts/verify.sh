@@ -32,6 +32,14 @@ else
   echo "SKIPPED: mypy not installed in this image (config: pyproject.toml [tool.mypy])"
 fi
 
+step "chaos soak + failpoint counters (FAULTS.md)"
+# Runs the fault-injection suites by name so a transport regression
+# fails fast with a targeted log, before the full tier-1 sweep below
+# (which includes them again as ordinary members).
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_fault_injection.py tests/test_chaos_soak.py -q \
+  -p no:cacheprovider || fail=1
+
 step "python syntax floor (compileall)"
 # stdlib floor under the optional tools above: at minimum, every file parses
 python -m compileall -q euler_tpu tests scripts examples bench.py || fail=1

@@ -1,0 +1,201 @@
+"""Chaos soak: train over a live multi-shard TCP cluster while seeded
+failpoints fire, plus a real shard SIGKILL + restart mid-run.
+
+This is the capstone of the failpoint layer (_native/eg_fault, FAULTS.md):
+the transport faults that production serves daily — refused dials, slow
+links, mid-frame resets, a shard dying and coming back on a new port —
+are injected deterministically into a real 2-shard cluster (each shard a
+separate OS process, so the training process's injector touches ONLY the
+client paths and the ledger arithmetic stays exact), and the run must:
+
+  * complete, with every loss finite;
+  * converge to a final loss within tolerance of the fault-free run
+    (retry + backoff + quarantine + re-discovery absorb the chaos);
+  * account for every injected fault in the exported failure counters.
+
+Fault-sequence determinism (same seed => same injected-failure pattern)
+is pinned per failpoint in test_fault_injection.py; here the seed makes
+the soak reproducible in the aggregate. Counts still vary a little with
+scheduling (retries draw more hits), so the ledger checks are exact
+inequalities, not equalities.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import native
+from tests.fixture_graph import TOPOLOGY, write_fixture
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NUM_SHARDS = 2
+NUM_PARTITIONS = 4
+STEPS = 36
+KILL_STEP = 12     # SIGKILL shard 1 before this step...
+RESTART_STEP = 14  # ...and bring it back (new port) before this one
+# client-path faults only: dial refusals, slow sends, mid-frame resets.
+# Probabilities low enough that retries=8 makes per-call success ~certain
+# once the cluster is up; the shard kill supplies the real failures.
+FAULT_SPEC = "dial:err@0.2,send_frame:delay@3@0.3,recv_frame:err@0.15"
+FAULT_SEED = 20260804
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    native.fault_clear()
+    native.counters_reset()
+    yield
+    native.fault_clear()
+    native.counters_reset()
+
+
+def _launch_shard(idx: int, data: str, reg: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return subprocess.Popen(
+        [sys.executable, "-m", "euler_tpu.graph.service",
+         "--data_dir", data, "--shard_idx", str(idx),
+         "--shard_num", str(NUM_SHARDS), "--registry", reg],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+
+
+def _wait_registered(idx: int, reg: str, timeout: float = 90.0) -> None:
+    """Wait until shard idx has a registry entry that actually accepts
+    connections. A SIGKILLed prior incarnation leaves its stale file
+    behind — the dial probe is what rejects it, exactly like
+    run_loop.build_graph's liveness filter."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for f in os.listdir(reg):
+            if not f.startswith(f"{idx}#"):
+                continue
+            host, port = f.split("#", 1)[1].rsplit("_", 1)
+            try:
+                with socket.create_connection((host, int(port)), 1.0):
+                    return
+            except OSError:
+                continue
+        time.sleep(0.1)
+    raise TimeoutError(f"shard {idx} never came up in {reg}")
+
+
+def test_chaos_soak_trains_through_faults_and_shard_restart(tmp_path):
+    import jax
+
+    import euler_tpu
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import SupervisedGraphSage
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=NUM_PARTITIONS)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+
+    model = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+    )
+    opt = train_lib.get_optimizer("adam", 0.05)
+    step = jax.jit(model.make_train_step(opt), donate_argnums=(0,))
+    roots = np.array(sorted(TOPOLOGY), dtype=np.int64)
+
+    def run(graph, hook=None):
+        native.lib().eg_seed(1234)
+        state = model.init_state(jax.random.PRNGKey(0), graph, roots, opt)
+        losses = []
+        for i in range(STEPS):
+            if hook is not None:
+                hook(i)
+            batch = model.sample(graph, roots)
+            state, loss, _ = step(state, batch)
+            losses.append(float(loss))
+        return losses
+
+    procs = {}
+    try:
+        for s in range(NUM_SHARDS):
+            procs[s] = _launch_shard(s, data, reg)
+        for s in range(NUM_SHARDS):
+            _wait_registered(s, reg)
+
+        # ---- fault-free reference run ----
+        g = euler_tpu.Graph(mode="remote", registry=reg, retries=8,
+                            timeout_ms=2000, backoff_ms=2)
+        assert g.num_shards == NUM_SHARDS
+        clean = run(g)
+        g.close()
+
+        # ---- chaos run: seeded failpoints + shard kill/restart ----
+        native.counters_reset()
+        g = euler_tpu.Graph(
+            mode="remote", registry=reg, retries=8, timeout_ms=2000,
+            backoff_ms=2, rediscover_ms=300,
+            fault=FAULT_SPEC, fault_seed=FAULT_SEED,
+        )
+
+        def chaos(i):
+            if i == KILL_STEP:
+                procs[1].send_signal(signal.SIGKILL)
+                procs[1].wait()
+            if i == RESTART_STEP:
+                procs[1] = _launch_shard(1, data, reg)
+                _wait_registered(1, reg)
+                # let re-discovery learn the NEW port and route around
+                # the stale entry before the tail of the run; id 13 lives
+                # on shard 1 ((13 % 4) % 2 == 1), type 1 when reachable
+                probe = np.array([13], dtype=np.int64)
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    if int(g.node_types(probe)[0]) == 1:
+                        return
+                    time.sleep(0.2)
+                raise TimeoutError("restarted shard never rejoined")
+
+        faulted = run(g, chaos)
+        injected = native.fault_injected()
+        counters = native.counters()
+        g.close()
+
+        # the run completed, every loss finite, and it actually trained
+        assert all(np.isfinite(x) for x in clean + faulted)
+        clean_final = float(np.mean(clean[-5:]))
+        fault_final = float(np.mean(faulted[-5:]))
+        assert fault_final < faulted[0], (faulted[0], fault_final)
+        assert abs(fault_final - clean_final) < 0.4, (clean_final,
+                                                      fault_final)
+
+        # every configured failpoint demonstrably fired
+        assert injected["dial"] > 0, injected
+        assert injected["send_frame"] > 0, injected
+        assert injected["recv_frame"] > 0, injected
+
+        # ledger: the counters account for every injected fault. The
+        # training process runs no service, so its dial/send/recv hooks
+        # sit exclusively in ConnPool::Call — each injected dial fault is
+        # a counted failed dial, each failing fault quarantines a replica
+        # and is followed by a retry or a counted failed call. Real
+        # failures from the shard kill only push the counters higher.
+        failing = injected["dial"] + injected["recv_frame"]
+        assert counters["dials_failed"] >= injected["dial"], (injected,
+                                                              counters)
+        assert counters["quarantines"] >= failing, (injected, counters)
+        assert (counters["retries"] + counters["calls_failed"]
+                >= failing), (injected, counters)
+        # the kill/restart path was really exercised
+        assert counters["failovers"] >= 1, counters
+        assert counters["rediscoveries"] >= 1, counters
+    finally:
+        native.fault_clear()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()

@@ -188,6 +188,12 @@ def test_two_level_typed_negatives_multi_segment(graph, meta, monkeypatch):
     monkeypatch.setattr(device, "SEG", 2)
     ts = device.build_typed_node_sampler(graph, meta["node_type_num"], MAX_ID)
     assert ts["seg_cum"].shape[0] > ts["off"].shape[0] - 1
+    # the 0.03 gate below is tight enough that the SRC draw must be
+    # pinned: inheriting whatever thread-RNG state earlier tests left
+    # behind made this pass or fail with suite composition
+    from euler_tpu.graph.native import lib as native_lib
+
+    native_lib().eg_seed(182)
     src = graph.sample_node(64, -1)
     negs = np.asarray(
         device.sample_node_with_src(ts, src, jax.random.PRNGKey(1), 64)

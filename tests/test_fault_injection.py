@@ -1,0 +1,343 @@
+"""Deterministic failpoints (_native/eg_fault) + failure counters.
+
+Every failure path in the remote stack used to be reachable only by real
+process kills; these tests drive each one through the seeded failpoint
+layer and pin the exact counter arithmetic: each counter increments
+precisely when its failpoint fires, the injected-fault ledger matches,
+and a fault seed replays the identical failure sequence (the property
+the chaos soak in test_chaos_soak.py builds on).
+
+The injector is process-global (like the stats it feeds), so every test
+clears it on the way out — a leaked failpoint would chaos-test the rest
+of the suite.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import native
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.service import GraphService
+
+COUNTER_NAMES = {
+    "dials_failed", "retries", "quarantines", "failovers", "calls_failed",
+    "deadlines_exceeded", "frames_rejected", "rediscoveries",
+    "heartbeat_misses",
+}
+FAULT_NAMES = {
+    "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
+    "heartbeat",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No failpoint may outlive its test (process-global injector)."""
+    native.fault_clear()
+    native.counters_reset()
+    yield
+    native.fault_clear()
+    native.counters_reset()
+
+
+@pytest.fixture(scope="module")
+def shard(tmp_path_factory):
+    """One live shard on an ephemeral port + its flat-file registry."""
+    from tests.fixture_graph import write_fixture
+
+    data = str(tmp_path_factory.mktemp("fault_data"))
+    write_fixture(data, num_partitions=2)
+    reg = str(tmp_path_factory.mktemp("fault_reg"))
+    svc = GraphService(data, 0, 1, registry=reg)
+    yield svc, reg
+    svc.stop()
+
+
+def nonzero(d):
+    return {k: v for k, v in d.items() if v}
+
+
+# ---------------------------------------------------------------------------
+# surface: spec grammar, names, Python round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_counters_round_trip_to_python():
+    import euler_tpu
+
+    snap = euler_tpu.counters()
+    assert set(snap) == COUNTER_NAMES
+    assert all(isinstance(v, int) for v in snap.values())
+    euler_tpu.counters_reset()
+    assert nonzero(euler_tpu.counters()) == {}
+
+
+def test_fault_ledger_names():
+    assert set(native.fault_injected()) == FAULT_NAMES
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "bogus:err@0.5",          # unknown point
+        "dial",                   # no action
+        "dial:explode@1",         # unknown action
+        "dial:err@0.0",           # probability out of (0,1]
+        "dial:err@2.0",
+        "dial:err@x",
+        "dial:delay@-5",
+        "dial:err@0.5#x",         # bad limit
+        "dial:err@0.5,dial:err@0.5",  # duplicate point
+    ],
+)
+def test_malformed_specs_raise_and_install_nothing(bad):
+    with pytest.raises(ValueError):
+        native.fault_config(bad, 1)
+    assert nonzero(native.fault_injected()) == {}
+
+
+def test_valid_spec_forms_accepted():
+    native.fault_config(
+        "dial:err@1.0#2,send_frame:delay@10,recv_frame:delay@5@0.5#3", 9
+    )
+    native.fault_config("", 0)  # empty spec clears
+
+
+def test_graph_rejects_fault_on_local_mode(shard, tmp_path):
+    svc, reg = shard
+    with pytest.raises(ValueError, match="remote"):
+        Graph(directory=str(tmp_path), fault="dial:err@0.5")
+
+
+# ---------------------------------------------------------------------------
+# each counter increments exactly when its failpoint fires
+# ---------------------------------------------------------------------------
+
+
+def test_dial_fault_counts_exactly(shard):
+    svc, reg = shard
+    # Init performs exactly one kInfo Call; dial:err@1.0#2 fails the
+    # first two attempts, the third dials clean — each number is forced.
+    g = Graph(mode="remote", registry=reg, retries=3, timeout_ms=2000,
+              backoff_ms=1, fault="dial:err@1.0#2", fault_seed=1)
+    try:
+        assert native.fault_injected()["dial"] == 2
+        ctr = native.counters()
+        assert ctr["dials_failed"] == 2
+        assert ctr["retries"] == 2
+        assert ctr["quarantines"] == 2
+        assert ctr["failovers"] == 1
+        assert ctr["calls_failed"] == 0
+    finally:
+        g.close()
+
+
+def test_send_frame_fault_counts_exactly(shard):
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=3, timeout_ms=2000,
+              backoff_ms=1)
+    try:
+        ids = np.array([10, 11, 12, 13], dtype=np.int64)
+        g.node_types(ids)  # warm the pooled connection
+        native.fault_config("send_frame:err@1.0#1", 5)
+        native.counters_reset()
+        t = g.node_types(ids)
+        np.testing.assert_array_equal(t, [0, 1, 0, 1])  # retried through
+        assert native.fault_injected()["send_frame"] == 1
+        ctr = native.counters()
+        assert ctr["retries"] == 1
+        assert ctr["quarantines"] == 1
+        assert ctr["failovers"] == 1
+        assert ctr["dials_failed"] == 0  # the redial succeeded
+    finally:
+        g.close()
+
+
+def test_recv_frame_fault_counts_exactly(shard):
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=3, timeout_ms=2000,
+              backoff_ms=1)
+    try:
+        ids = np.array([10, 11], dtype=np.int64)
+        g.node_types(ids)
+        # the in-process shard shares the injector, and recv_frame fires
+        # only once a frame has begun arriving — so the one fire lands
+        # deterministically on the shard reading the request (the request
+        # header always precedes the reply header); the client sees its
+        # connection die mid-exchange and must fail over
+        native.fault_config("recv_frame:err@1.0#1", 5)
+        native.counters_reset()
+        t = g.node_types(ids)
+        np.testing.assert_array_equal(t, [0, 1])
+        assert native.fault_injected()["recv_frame"] == 1
+        ctr = native.counters()
+        assert ctr["retries"] == 1, ctr
+        assert ctr["quarantines"] == 1, ctr
+        assert ctr["failovers"] == 1, ctr
+    finally:
+        g.close()
+
+
+def test_deadline_spans_all_retries(shard):
+    svc, reg = shard
+    # recv always fails; generous retries but a 150 ms overall budget.
+    # Without the per-call deadline this would grind through 10 backoff
+    # sleeps; with it the call must abort quickly and say so.
+    g = Graph(mode="remote", registry=reg, retries=10, timeout_ms=2000,
+              backoff_ms=400, deadline_ms=150)
+    try:
+        g.node_types(np.array([10], dtype=np.int64))  # warm up, no faults
+        native.fault_config("recv_frame:err@1.0", 3)
+        native.counters_reset()
+        t0 = time.monotonic()
+        t = g.node_types(np.array([10], dtype=np.int64))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.5, "deadline did not bound the retry loop"
+        assert t[0] == -1  # degraded to default, not wedged
+        ctr = native.counters()
+        assert ctr["deadlines_exceeded"] == 1
+        assert ctr["calls_failed"] == 1
+    finally:
+        native.fault_clear()
+        g.close()
+
+
+def test_frames_rejected_on_error_status_reply(shard):
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=1, timeout_ms=2000)
+    try:
+        native.counters_reset()
+        # a request whose result cannot fit a reply frame gets an error
+        # status from the shard (OversizedResult) — the client must count
+        # the refusal, not silently zero-fill
+        out = g.get_dense_feature(
+            np.array([10], dtype=np.int64), [0], [2 ** 29]
+        )
+        assert float(np.abs(out).sum()) == 0.0
+        assert native.counters()["frames_rejected"] >= 1
+    finally:
+        g.close()
+
+
+def test_delay_fault_injects_latency_without_failing(shard):
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=1, timeout_ms=2000)
+    try:
+        ids = np.array([10, 11], dtype=np.int64)
+        g.node_types(ids)
+        native.fault_config("send_frame:delay@80", 11)
+        native.counters_reset()
+        t0 = time.monotonic()
+        t = g.node_types(ids)
+        elapsed = time.monotonic() - t0
+        np.testing.assert_array_equal(t, [0, 1])  # slow, not wrong
+        assert elapsed >= 0.08
+        assert native.fault_injected()["send_frame"] >= 1
+        assert native.counters()["retries"] == 0  # delay is not a failure
+    finally:
+        g.close()
+
+
+def test_service_reply_fault_forces_client_retry(shard):
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=3, timeout_ms=2000,
+              backoff_ms=1)
+    try:
+        ids = np.array([10, 11, 12, 13], dtype=np.int64)
+        g.node_types(ids)
+        # the shard runs in-process here, so its failpoints and the
+        # client's share one injector — exactly one computed reply is
+        # dropped on the floor before send
+        native.fault_config("service_reply:err@1.0#1", 5)
+        native.counters_reset()
+        t = g.node_types(ids)
+        np.testing.assert_array_equal(t, [0, 1, 0, 1])
+        assert native.fault_injected()["service_reply"] == 1
+        assert native.counters()["retries"] >= 1
+    finally:
+        g.close()
+
+
+def test_heartbeat_fault_counts_misses_and_survives(tmp_path):
+    from euler_tpu.graph import registry as registry_mod
+    from tests.fixture_graph import write_fixture
+
+    import os
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=2)
+    reg = registry_mod.RegistryServer(host="127.0.0.1", ttl_ms=600)
+    svc = None
+    try:
+        svc = GraphService(data, 0, 1, registry=reg.address)
+        # beats run every max(ttl/3, 150) = 200 ms; force the next two to
+        # miss — each miss must redial and re-REG so the entry stays live
+        native.fault_config("heartbeat:err@1.0#2", 21)
+        native.counters_reset()
+        deadline = time.monotonic() + 5.0
+        while (native.fault_injected()["heartbeat"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert native.fault_injected()["heartbeat"] == 2
+        assert native.counters()["heartbeat_misses"] == 2
+        # despite two missed beats the shard never expired from LIST
+        time.sleep(0.7)  # > ttl: only the redial re-REGs keep it alive
+        assert 0 in registry_mod.query(reg.address)
+    finally:
+        native.fault_clear()
+        if svc is not None:
+            svc.stop()
+        reg.stop()
+
+
+def test_registry_reply_fault_fails_one_list(tmp_path):
+    from euler_tpu.graph import registry as registry_mod
+
+    reg = registry_mod.RegistryServer(host="127.0.0.1", ttl_ms=5000)
+    try:
+        registry_mod.query(reg.address)  # clean LIST works
+        native.fault_config("registry_reply:err@1.0#1", 3)
+        with pytest.raises(ConnectionError):
+            registry_mod.query(reg.address)
+        assert native.fault_injected()["registry_reply"] == 1
+        registry_mod.query(reg.address)  # next LIST answers again
+    finally:
+        native.fault_clear()
+        reg.stop()
+
+
+# ---------------------------------------------------------------------------
+# determinism: the seed owns the failure sequence
+# ---------------------------------------------------------------------------
+
+
+def _failure_pattern(reg, seed, n=48):
+    """Per-call success/failure pattern of n sequential single-id queries
+    under send_frame:err@0.5 with zero retries. The in-process shard
+    shares the injector, so the stream's draws interleave client
+    request-sends and shard reply-sends — but on a single connection that
+    interleaving is itself fixed, so the observable pattern is a pure
+    function of the seed."""
+    g = Graph(mode="remote", registry=reg, retries=0, timeout_ms=2000,
+              quarantine_ms=1)
+    try:
+        one = np.array([10], dtype=np.int64)
+        g.node_types(one)  # warm-up before the faults arm
+        native.fault_config("send_frame:err@0.5", seed)
+        return tuple(int(g.node_types(one)[0]) == 0 for _ in range(n))
+    finally:
+        native.fault_clear()
+        g.close()
+
+
+def test_same_seed_replays_identical_failure_sequence(shard):
+    svc, reg = shard
+    a1 = _failure_pattern(reg, seed=1234)
+    a2 = _failure_pattern(reg, seed=1234)
+    b = _failure_pattern(reg, seed=99)
+    assert a1 == a2, "same seed must replay the same injected failures"
+    assert a1 != b, "a different seed must explore a different sequence"
+    assert any(a1) and not all(a1), "p=0.5 must mix successes and failures"

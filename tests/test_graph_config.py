@@ -172,3 +172,21 @@ def test_rediscover_ms_via_config_file(tmp_path):
         assert g.num_shards == 1
         assert len(g.sample_node(4, -1)) == 4
         g.close()
+
+
+def test_directory_and_files_together_rejected(fixture_dir):
+    """files= next to directory= used to be silently ignored (the load
+    dispatch consumes directory= first) — it must be a loud error, same
+    principle as the stream=/remote rejection."""
+    with pytest.raises(ValueError, match="not both"):
+        Graph(directory=fixture_dir, files=[fixture_dir + "/part_0.dat"])
+    # via config string too (the merge happens after config resolution)
+    with pytest.raises(ValueError, match="not both"):
+        Graph(config=f"directory={fixture_dir};files=a.dat,b.dat")
+
+
+def test_fault_kwarg_rejected_on_local_graph(fixture_dir):
+    """fault= names transport failpoints; a local graph has no transport,
+    so accepting it would silently inject nothing."""
+    with pytest.raises(ValueError, match="remote"):
+        Graph(directory=fixture_dir, fault="dial:err@0.5")

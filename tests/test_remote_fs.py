@@ -342,3 +342,21 @@ def test_stream_explicit_file_list(mem_graph_url, tmp_path):
     assert gs.num_edges == gd.num_edges
     gs.close()
     gd.close()
+
+
+def test_read_files_rejects_duplicate_urls(tmp_path):
+    """Duplicate URLs would reach the native name-sorted merge as equal
+    keys (unspecified relative order => nondeterministic store); both
+    the streamed and staged list paths must refuse them up front."""
+    import pytest
+
+    from euler_tpu.graph import remote_fs
+
+    f = tmp_path / "a.dat"
+    f.write_bytes(b"x")
+    with pytest.raises(ValueError, match="duplicate"):
+        remote_fs.read_files([str(f), str(f)])
+    with pytest.raises(ValueError, match="duplicate"):
+        remote_fs.stage_files([str(f), str(f)])
+    # unique lists still pass straight through
+    assert remote_fs.stage_files([str(f)]) == [str(f)]

@@ -42,7 +42,10 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 18, files  # all .cc and .h of _native
+    assert len(files) >= 20, files  # all .cc and .h of _native
+    # the fault layer must be under the gate, not grandfathered around it
+    names = {pathlib.Path(f).name for f in files}
+    assert {"eg_fault.cc", "eg_fault.h"} <= names, names
     violations = []
     for f in files:
         violations.extend(check_native.lint_file(f))
@@ -222,6 +225,58 @@ def test_thread_rng_fires():
 def test_thread_rng_accepts_thread_rng():
     snippet = "int Draw() {\n  return ThreadRng().NextLess(10);\n}\n"
     assert lint(snippet) == []
+
+
+# ---------------------------------------------------------------------------
+# fault-layer shapes: the eg_fault.cc/capi surface stays under the same gate
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_fault_config_shape():
+    """The failpoint ABI parses operator-typed spec strings — exactly the
+    kind of entry point where a stray stoi/stod throw would cross the C
+    ABI. A guardless eg_fault_config-shaped function must be caught."""
+    snippet = (
+        'extern "C" {\n'
+        "int eg_fault_config(const char* spec, uint64_t seed) {\n"
+        "  return Configure(spec, seed) ? 0 : -1;\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_fault_config" in v.message
+
+
+def test_thread_catch_fires_on_heartbeat_loop_shape():
+    """The heartbeat thread now hosts a failpoint (FaultHit can sleep and
+    its redial path allocates) — its entry lambda stays under the
+    thread-catch rule like every other service thread."""
+    snippet = (
+        "void Start() {\n"
+        "  heartbeat_thread_ = std::thread([this]() mutable {\n"
+        "    while (!stop_) {\n"
+        "      if (FaultHit(kFaultHeartbeat) || !RegistrySend(fd, line))\n"
+        "        Redial();\n"
+        "    }\n"
+        "  });\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
+
+
+def test_wire_count_alloc_fires_on_config_derived_count():
+    """A fault-spec-driven allocation (e.g. sizing a table from a parsed
+    limit read out of a wire config frame) is the same crash class as any
+    wire-derived count: bound before resize."""
+    snippet = (
+        "void Install(WireReader* r) {\n"
+        "  int32_t npoints = r->I32();\n"
+        "  points_.resize(npoints);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert "npoints" in v.message
 
 
 # ---------------------------------------------------------------------------
