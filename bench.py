@@ -131,6 +131,14 @@ CONFIGS = {
         batch=1000, fanouts=(4, 4), dim=64, lr=0.03,
         warmup=3, measure=15, powerlaw=True, alias_sampling=True,
     ),
+    # The sharded REMOTE path (scripts/remote_bench.py): edges/s of a
+    # 2-hop fanout + feature batch against a local 2-shard cluster,
+    # before/after the dedup + cache + dispatcher optimizations, with
+    # the ids-on-wire counter ledger. No model training, no TPU — this
+    # measures the remote client, the ROADMAP's serve-millions tier.
+    # Not in the default list (the single-chip configs are the
+    # headline); opt in with --configs remote.
+    "remote": dict(remote=True),
 }
 
 def detect_pallas_kernel(state) -> bool:
@@ -322,6 +330,20 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
     result BEFORE the device-sampling section starts (and callers bank
     the final dict themselves) — a wedge mid-config then loses the
     device-sampling delta, not the whole config."""
+    if cfg.get("remote"):
+        # the remote-client benchmark: no jax, no model — delegate to
+        # scripts/remote_bench.py (one measurement implementation shared
+        # with the verify.sh smoke gate, so the two cannot drift)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "remote_bench",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "remote_bench.py"),
+        )
+        remote_bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(remote_bench)
+        return remote_bench.run_remote_bench()
     import jax
 
     import euler_tpu
@@ -654,6 +676,7 @@ CONFIG_CAPS = {
     "reddit": 900.0,
     "reddit_bf16": 900.0,
     "reddit_heavytail": 1500.0,
+    "remote": 900.0,
 }
 
 

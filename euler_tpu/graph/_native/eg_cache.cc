@@ -1,0 +1,91 @@
+#include "eg_cache.h"
+
+#include <cstring>
+
+namespace eg {
+
+void FeatureCache::SetCapacity(size_t bytes) {
+  cap_ = bytes;
+  if (cap_ != 0) return;
+  for (auto& st : stripes_) {
+    std::lock_guard<std::mutex> l(st.mu);
+    st.map.clear();
+    st.fifo.clear();
+    st.bytes = 0;
+  }
+}
+
+uint64_t FeatureCache::SpecHash(const int32_t* fids, const int32_t* dims,
+                                int nf) {
+  uint64_t h = 0xCBF29CE484222325ULL;  // FNV-1a
+  auto mix = [&h](int32_t v) {
+    for (int b = 0; b < 4; ++b) {
+      h ^= static_cast<uint64_t>((v >> (8 * b)) & 0xFF);
+      h *= 0x100000001B3ULL;
+    }
+  };
+  for (int k = 0; k < nf; ++k) mix(fids[k]);
+  for (int k = 0; k < nf; ++k) mix(dims[k]);
+  return h;
+}
+
+uint64_t FeatureCache::Mix(uint64_t spec, uint64_t id) {
+  // splitmix64 finalizer over the combined key
+  uint64_t z = spec ^ (id + 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+bool FeatureCache::Get(uint64_t spec, uint64_t id, float* out,
+                       size_t row_dim) {
+  if (cap_ == 0) return false;
+  uint64_t key = Mix(spec, id);
+  Stripe& st = stripes_[key % kStripes];
+  std::lock_guard<std::mutex> l(st.mu);
+  auto it = st.map.find(key);
+  // the full (spec, id, dim) identity is verified: a key collision is a
+  // miss, never somebody else's row
+  if (it == st.map.end() || it->second.spec != spec || it->second.id != id ||
+      it->second.row.size() != row_dim)
+    return false;
+  std::memcpy(out, it->second.row.data(), row_dim * sizeof(float));
+  return true;
+}
+
+void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
+                       size_t row_dim) {
+  if (cap_ == 0) return;
+  size_t cost = row_dim * sizeof(float) + kEntryOverhead;
+  size_t stripe_cap = cap_ / kStripes;
+  if (cost > stripe_cap) return;  // a single over-budget row never caches
+  uint64_t key = Mix(spec, id);
+  Stripe& st = stripes_[key % kStripes];
+  std::lock_guard<std::mutex> l(st.mu);
+  if (st.map.count(key)) return;  // racing fetchers: first insert wins
+  while (st.bytes + cost > stripe_cap && !st.fifo.empty()) {
+    auto victim = st.map.find(st.fifo.front());
+    st.fifo.pop_front();
+    if (victim == st.map.end()) continue;
+    st.bytes -= victim->second.row.size() * sizeof(float) + kEntryOverhead;
+    st.map.erase(victim);
+  }
+  Entry e;
+  e.spec = spec;
+  e.id = id;
+  e.row.assign(row, row + row_dim);
+  st.map.emplace(key, std::move(e));
+  st.fifo.push_back(key);
+  st.bytes += cost;
+}
+
+size_t FeatureCache::bytes() const {
+  size_t total = 0;
+  for (const auto& st : stripes_) {
+    std::lock_guard<std::mutex> l(st.mu);
+    total += st.bytes;
+  }
+  return total;
+}
+
+}  // namespace eg

@@ -1,0 +1,73 @@
+// Client-side dense-feature-row cache for the remote graph client.
+//
+// The graph is immutable after load (the engine has no mutation API and
+// the shard services never rewrite a loaded store), so a feature row
+// fetched once is valid forever — no invalidation protocol, just a
+// capacity bound. On heavy-tail graphs the same hub rows are refetched
+// endlessly by successive batches (hubs carry most edge mass, so every
+// fanout lands on them); caching them client-side removes those rows
+// from the wire entirely. Config key `feature_cache_mb=` (remote graphs;
+// default on at a small budget, 0 disables).
+//
+// Keyed by (feature-spec hash, node id): the same id requested with
+// different fids/dims is a different row, so the spec participates in
+// the key and is verified on hit (a 64-bit map-key collision degrades to
+// a miss, never to a wrong row). Striped locking + per-stripe FIFO
+// eviction: hot hubs re-enter within a batch or two, so recency tracking
+// buys little over FIFO here and FIFO keeps the hit path to one hash
+// probe under a stripe mutex.
+#ifndef EG_CACHE_H_
+#define EG_CACHE_H_
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace eg {
+
+class FeatureCache {
+ public:
+  // Total byte budget across stripes; 0 disables (Get misses, Put drops).
+  void SetCapacity(size_t bytes);
+  bool enabled() const { return cap_ != 0; }
+
+  // FNV-1a over the (fids, dims) request shape — the spec half of the key.
+  static uint64_t SpecHash(const int32_t* fids, const int32_t* dims, int nf);
+
+  // On hit, copy row_dim floats into out and return true.
+  bool Get(uint64_t spec, uint64_t id, float* out, size_t row_dim);
+  // Insert a fetched row (no-op when disabled or already present).
+  void Put(uint64_t spec, uint64_t id, const float* row, size_t row_dim);
+
+  // Resident payload bytes (approximate: entry overhead included) —
+  // observability for tests pinning the capacity bound.
+  size_t bytes() const;
+
+ private:
+  struct Entry {
+    uint64_t spec;
+    uint64_t id;
+    std::vector<float> row;
+  };
+  struct Stripe {
+    mutable std::mutex mu;
+    std::unordered_map<uint64_t, Entry> map;
+    std::deque<uint64_t> fifo;  // insertion order of map keys
+    size_t bytes = 0;
+  };
+  static constexpr int kStripes = 16;
+  // ~per-entry bookkeeping cost charged against the budget on top of the
+  // row payload (map node + fifo slot + Entry header).
+  static constexpr size_t kEntryOverhead = 96;
+
+  static uint64_t Mix(uint64_t spec, uint64_t id);
+
+  size_t cap_ = 0;
+  Stripe stripes_[kStripes];
+};
+
+}  // namespace eg
+
+#endif  // EG_CACHE_H_

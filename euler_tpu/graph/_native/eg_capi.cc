@@ -167,6 +167,26 @@ int eg_remote_replica_count(void* h, int shard) {
   }
   EG_API_GUARD(-1)
 }
+// Pending strict-mode failure of a remote graph (strict=1 config key):
+// copies the first recorded message into buf (NUL-terminated, truncated
+// to cap) and clears it, returning 1; 0 when nothing is pending. The
+// fixed-shape query entry points return void, so a shard that failed
+// after every transport retry surfaces here — the Python client polls
+// this after each remote call and raises instead of training on the
+// default-filled rows.
+int eg_remote_strict_error(void* h, char* buf, int cap) {
+  try {
+    std::string err = static_cast<RemoteGraph*>(API(h))->TakeStrictError();
+    if (err.empty()) return 0;
+    if (cap > 0) {
+      size_t m = std::min(err.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, err.data(), m);
+      buf[m] = '\0';
+    }
+    return 1;
+  }
+  EG_API_GUARD(-1)
+}
 
 // ---- graph service (StartService equivalent,
 // reference euler/service/python_api.cc:26-52) ----

@@ -1,0 +1,67 @@
+#include "eg_dispatch.h"
+
+namespace eg {
+
+Dispatcher::Dispatcher(int workers) {
+  if (workers < 1) workers = 1;
+  threads_.reserve(static_cast<size_t>(workers));
+  for (int i = 0; i < workers; ++i)
+    threads_.emplace_back([this] {
+      try {
+        WorkerLoop();
+      } catch (...) {
+        // std::terminate barrier (eg-lint: thread-catch): a dead worker
+        // only shrinks the pool; remaining workers keep draining
+      }
+    });
+}
+
+Dispatcher::~Dispatcher() {
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : threads_) t.join();
+}
+
+void Dispatcher::WorkerLoop() {
+  for (;;) {
+    Task task{nullptr, nullptr};
+    {
+      std::unique_lock<std::mutex> l(mu_);
+      cv_.wait(l, [this] { return stop_ || !queue_.empty(); });
+      if (queue_.empty()) return;  // stop_ set and everything drained
+      task = queue_.front();
+      queue_.pop_front();
+    }
+    try {
+      (*task.fn)();
+    } catch (...) {
+      // a throwing job degrades like a failed shard call: its rows keep
+      // their prefilled defaults (callers record the failure themselves)
+    }
+    {
+      // notify while holding the batch lock: Run() may destroy the Batch
+      // the instant its wait observes remaining == 0, so the notify must
+      // not race a spurious wakeup into a use-after-free
+      std::lock_guard<std::mutex> l(task.batch->mu);
+      if (--task.batch->remaining == 0) task.batch->done.notify_all();
+    }
+  }
+}
+
+void Dispatcher::Run(const std::vector<std::function<void()>>& jobs) const {
+  if (jobs.empty()) return;
+  Batch batch;
+  batch.remaining = jobs.size();
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    for (const auto& j : jobs) queue_.push_back(Task{&j, &batch});
+  }
+  cv_.notify_all();
+  std::unique_lock<std::mutex> l(batch.mu);
+  batch.done.wait(l, [&batch] { return batch.remaining == 0; });
+}
+
+}  // namespace eg

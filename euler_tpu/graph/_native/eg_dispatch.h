@@ -1,0 +1,80 @@
+// Persistent shard-request dispatcher for the remote client.
+//
+// The pre-dispatcher ForShards fan-out spawned and joined one ephemeral
+// std::thread per shard on EVERY query (9 call sites in eg_remote.cc) —
+// at Reddit-scale batch rates that is thousands of thread create/join
+// pairs per second of pure overhead on the hot path, exactly the
+// communication tax FastSample (PAPERS.md, arxiv 2311.17847) and the
+// pipelined-sampling line (arxiv 2110.08450) say to cut. This replaces
+// it with a single long-lived worker pool owned by the RemoteGraph:
+// callers submit a batch of independent jobs (one per shard, or several
+// per shard when a large request is split into chunks) and block until
+// the batch completes.
+//
+// One pool shared across all shards rather than one thread per
+// ConnPool: chunked requests to a single shard must be issuable
+// concurrently over multiple pooled sockets, which a strict
+// one-worker-per-pool design cannot do. Per-shard fairness comes from
+// FIFO submission order; the ConnPools themselves stay per-shard.
+//
+// Concurrency contract: jobs must never call Run() themselves (a job
+// waiting on workers while holding a worker slot can starve the pool).
+// Every eg_remote job is a leaf — encode / Call / decode — so this
+// holds by construction. Multiple client threads (prefetch workers) may
+// call Run() concurrently; batches interleave on the shared queue and
+// complete independently.
+#ifndef EG_DISPATCH_H_
+#define EG_DISPATCH_H_
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace eg {
+
+class Dispatcher {
+ public:
+  // Starts `workers` long-lived threads (clamped to >= 1).
+  explicit Dispatcher(int workers);
+  // Drains the queue, then stops and joins every worker. No Run() may be
+  // in flight (the owning RemoteGraph is being destroyed).
+  ~Dispatcher();
+
+  Dispatcher(const Dispatcher&) = delete;
+  Dispatcher& operator=(const Dispatcher&) = delete;
+
+  // Run every job on the worker pool and block until all complete. The
+  // job closures are borrowed (the caller's vector must outlive the
+  // call — it does, Run blocks). A throwing job counts as completed:
+  // its effects degrade exactly like a failed shard call (callers wrap
+  // jobs so failure is recorded before the exception would escape).
+  void Run(const std::vector<std::function<void()>>& jobs) const;
+
+  int workers() const { return static_cast<int>(threads_.size()); }
+
+ private:
+  struct Batch {
+    std::mutex mu;
+    std::condition_variable done;
+    size_t remaining = 0;
+  };
+  struct Task {
+    const std::function<void()>* fn;
+    Batch* batch;
+  };
+
+  void WorkerLoop();
+
+  mutable std::mutex mu_;  // guards queue_ and stop_
+  mutable std::condition_variable cv_;
+  mutable std::deque<Task> queue_;
+  bool stop_ = false;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace eg
+
+#endif  // EG_DISPATCH_H_

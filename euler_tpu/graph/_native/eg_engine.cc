@@ -217,9 +217,18 @@ void Engine::SampleFanout(const uint64_t* ids, int n,
   const uint64_t* cur = ids;
   int64_t cur_n = n;
   const int32_t* et = etypes_flat;
+  // n * prod(counts) past 2^31 would truncate in the per-hop int cast
+  // (same overflow class fixed in RemoteGraph::SampleFanout): issue each
+  // hop in bounded slices instead — per-row sampling makes the slicing
+  // invisible to the result.
+  const int64_t kSlice = int64_t{1} << 30;
   for (int h = 0; h < nhops; ++h) {
-    SampleNeighbor(cur, static_cast<int>(cur_n), et, etype_counts[h],
-                   counts[h], default_id, out_ids[h], out_w[h], out_t[h]);
+    for (int64_t off = 0; off < cur_n; off += kSlice) {
+      int m = static_cast<int>(std::min<int64_t>(kSlice, cur_n - off));
+      SampleNeighbor(cur + off, m, et, etype_counts[h], counts[h],
+                     default_id, out_ids[h] + off * counts[h],
+                     out_w[h] + off * counts[h], out_t[h] + off * counts[h]);
+    }
     cur = out_ids[h];
     cur_n *= counts[h];
     et += etype_counts[h];

@@ -10,6 +10,7 @@
 #include <map>
 #include <sstream>
 #include <thread>
+#include <unordered_map>
 
 #include "eg_fault.h"
 #include "eg_registry.h"
@@ -222,6 +223,8 @@ RemoteGraph::~RemoteGraph() {
     rediscover_stop_.store(true, std::memory_order_release);
     rediscover_thread_.join();
   }
+  // dispatcher_ (a member) destructs after this body: by then no query
+  // is in flight, so its queue is empty and the workers join promptly
 }
 
 bool RemoteGraph::Discover(
@@ -302,6 +305,19 @@ bool RemoteGraph::Init(const std::string& config) {
     quarantine_ms_ = std::stoi(cfg["quarantine_ms"]);
   if (cfg.count("backoff_ms")) backoff_ms_ = std::stoi(cfg["backoff_ms"]);
   if (cfg.count("deadline_ms")) deadline_ms_ = std::stoi(cfg["deadline_ms"]);
+  if (cfg.count("coalesce")) coalesce_ = std::stoi(cfg["coalesce"]) != 0;
+  if (cfg.count("strict")) strict_ = std::stoi(cfg["strict"]) != 0;
+  if (cfg.count("chunk_ids")) chunk_ids_ = std::stoi(cfg["chunk_ids"]);
+  if (chunk_ids_ < 1) chunk_ids_ = 1;
+  if (cfg.count("dispatch_workers"))
+    dispatch_workers_ = std::stoi(cfg["dispatch_workers"]);
+  // Dense-feature-row cache: default ON for remote graphs (the embedded
+  // engine has no cache — its rows are already local memory); 0 disables.
+  int cache_mb = 64;
+  if (cfg.count("feature_cache_mb"))
+    cache_mb = std::stoi(cfg["feature_cache_mb"]);
+  if (cache_mb < 0) cache_mb = 0;
+  fcache_.SetCapacity(static_cast<size_t>(cache_mb) << 20);
 
   // Deterministic transport failpoints (eg_fault.h). Installed BEFORE the
   // per-shard kInfo fetches below, so even Init's own calls replay under
@@ -367,6 +383,14 @@ bool RemoteGraph::Init(const std::string& config) {
     }
     for (auto& [host, port] : shards[s]) pools_[s].AddReplica(host, port);
   }
+
+  // Persistent scatter/gather pool: sized so every shard can be in
+  // flight at once with headroom for chunk fan-out and multiple client
+  // threads (prefetch workers) sharing the graph.
+  int workers = dispatch_workers_ > 0
+                    ? dispatch_workers_
+                    : std::min(64, std::max(8, 2 * num_shards_));
+  dispatcher_ = std::make_unique<Dispatcher>(workers);
 
   // Per-shard meta: weight sums for cross-shard weighted sampling (the role
   // of the reference's ZK shard_meta node_sum_weight/edge_sum_weight,
@@ -489,28 +513,137 @@ bool RemoteGraph::Call(int shard, const std::string& req,
   return true;
 }
 
+std::string RemoteGraph::TakeStrictError() const {
+  std::lock_guard<std::mutex> l(strict_mu_);
+  std::string out;
+  out.swap(strict_error_);
+  return out;
+}
+
+void RemoteGraph::ShardFailed(int shard, const char* what) const {
+  // Pre-dispatcher ForShards threw this bool away: a fully-failed shard
+  // silently yielded default rows. Now every op-level shard failure is
+  // at least counted, and under strict= it surfaces as an error.
+  Counters::Global().Add(kCtrRpcError);
+  if (!strict_) return;
+  std::lock_guard<std::mutex> l(strict_mu_);
+  if (strict_error_.empty())
+    strict_error_ = std::string(what) + ": shard " + std::to_string(shard) +
+                    " failed after all transport retries (strict=1; see "
+                    "rpc_errors/calls_failed counters)";
+}
+
 void RemoteGraph::GroupByShard(const uint64_t* ids, int n,
                                std::vector<std::vector<int32_t>>* rows) const {
   rows->assign(num_shards_, {});
   for (int i = 0; i < n; ++i) (*rows)[ShardOf(ids[i])].push_back(i);
 }
 
+void RemoteGraph::BuildPlan(const uint64_t* ids, int n,
+                            ShardPlan* p) const {
+  p->rows.assign(num_shards_, {});
+  p->reps.assign(num_shards_, {});
+  p->shard_of.assign(n, -1);
+  p->pos_of.assign(n, 0);
+  p->occ_of.assign(n, 0);
+  p->coalesced = 0;
+  if (!coalesce_) {
+    for (int i = 0; i < n; ++i) {
+      int s = ShardOf(ids[i]);
+      p->shard_of[i] = s;
+      p->pos_of[i] = static_cast<int32_t>(p->rows[s].size());
+      p->rows[s].push_back(i);
+      p->reps[s].push_back(1);
+    }
+    return;
+  }
+  // id -> position within its shard's unique list (the shard itself is a
+  // pure function of the id, so it needs no storing)
+  std::unordered_map<uint64_t, int32_t> seen;
+  seen.reserve(static_cast<size_t>(n) * 2);
+  for (int i = 0; i < n; ++i) {
+    int s = ShardOf(ids[i]);
+    auto [it, fresh] = seen.emplace(ids[i], 0);
+    if (fresh) {
+      it->second = static_cast<int32_t>(p->rows[s].size());
+      p->rows[s].push_back(i);
+      p->reps[s].push_back(1);
+    } else {
+      ++p->reps[s][it->second];
+      ++p->coalesced;
+    }
+    p->shard_of[i] = s;
+    p->pos_of[i] = it->second;
+    p->occ_of[i] = p->reps[s][it->second] - 1;
+  }
+  if (p->coalesced)
+    Counters::Global().Add(kCtrIdsDeduped,
+                           static_cast<uint64_t>(p->coalesced));
+}
+
+void RemoteGraph::BuildEdgePlan(const uint64_t* src, int n,
+                                ShardPlan* p) const {
+  p->rows.assign(num_shards_, {});
+  p->reps.assign(num_shards_, {});
+  p->shard_of.assign(n, -1);
+  p->pos_of.assign(n, 0);
+  p->occ_of.assign(n, 0);
+  p->coalesced = 0;
+  for (int i = 0; i < n; ++i) {
+    int s = ShardOf(src[i]);
+    p->shard_of[i] = s;
+    p->pos_of[i] = static_cast<int32_t>(p->rows[s].size());
+    p->rows[s].push_back(i);
+    p->reps[s].push_back(1);
+  }
+}
+
 void RemoteGraph::ForShards(const std::vector<std::vector<int32_t>>& rows,
+                            const char* what,
                             const std::function<bool(int)>& fn) const {
-  std::vector<std::thread> ts;
-  ts.reserve(rows.size());
+  std::vector<std::function<void()>> jobs;
+  jobs.reserve(rows.size());
   for (int s = 0; s < static_cast<int>(rows.size()); ++s)
     if (!rows[s].empty())
-      ts.emplace_back([&fn, s] {
+      jobs.emplace_back([this, &fn, s, what] {
+        bool ok = false;
         try {
-          fn(s);
+          ok = fn(s);
         } catch (...) {
-          // std::terminate barrier (eg-lint: thread-catch): a throwing
-          // shard call degrades like a failed one — its rows keep their
-          // prefilled defaults
+          // a throwing shard call degrades like a failed one — its rows
+          // keep their prefilled defaults (and the failure is recorded)
+          ok = false;
         }
+        if (!ok) ShardFailed(s, what);
       });
-  for (auto& t : ts) t.join();
+  dispatcher_->Run(jobs);
+}
+
+void RemoteGraph::RunChunked(
+    const std::vector<std::vector<int32_t>>& lists, const char* what,
+    const std::function<bool(int, int32_t, int32_t)>& chunk_fn) const {
+  std::vector<std::function<void()>> jobs;
+  for (int s = 0; s < static_cast<int>(lists.size()); ++s) {
+    int32_t m = static_cast<int32_t>(lists[s].size());
+    if (m == 0) continue;
+    int32_t step = std::min<int32_t>(chunk_ids_, m);
+    if (m > step)
+      Counters::Global().Add(kCtrRpcChunk,
+                             static_cast<uint64_t>((m + step - 1) / step));
+    for (int32_t b = 0; b < m; b += step) {
+      int32_t e = std::min(m, b + step);
+      jobs.emplace_back([this, &chunk_fn, s, b, e, what] {
+        bool ok = false;
+        try {
+          ok = chunk_fn(s, b, e);
+        } catch (...) {
+          ok = false;
+        }
+        if (!ok) ShardFailed(s, what);
+      });
+    }
+  }
+  dispatcher_->Run(jobs);
 }
 
 void RemoteGraph::DrawShards(bool edges, int32_t type, int count,
@@ -540,7 +673,7 @@ void RemoteGraph::SampleNode(int count, int32_t type, uint64_t* out) const {
   std::vector<std::vector<int32_t>> rows(num_shards_);
   for (int i = 0; i < count; ++i) rows[draw_shard[i]].push_back(i);
   std::fill(out, out + count, 0);
-  ForShards(rows, [&](int s) {
+  ForShards(rows, "sample_node", [&](int s) {
     WireWriter req;
     req.U8(kSampleNode);
     req.I32(static_cast<int32_t>(rows[s].size()));
@@ -568,7 +701,7 @@ void RemoteGraph::SampleEdge(int count, int32_t type, uint64_t* out_src,
   DrawShards(true, type, count, draw_shard.data());
   std::vector<std::vector<int32_t>> rows(num_shards_);
   for (int i = 0; i < count; ++i) rows[draw_shard[i]].push_back(i);
-  ForShards(rows, [&](int s) {
+  ForShards(rows, "sample_edge", [&](int s) {
     WireWriter req;
     req.U8(kSampleEdge);
     req.I32(static_cast<int32_t>(rows[s].size()));
@@ -596,11 +729,19 @@ void RemoteGraph::SampleEdge(int count, int32_t type, uint64_t* out_src,
 void RemoteGraph::GetNodeType(const uint64_t* ids, int n,
                               int32_t* out) const {
   std::fill(out, out + n, -1);
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> sub(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+  if (n <= 0) return;
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
+  // per-shard staging over UNIQUE entries; chunks write disjoint ranges
+  std::vector<std::vector<int32_t>> got(num_shards_);
+  std::vector<std::vector<char>> ok(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    got[s].assign(plan.rows[s].size(), -1);
+    ok[s].assign(plan.rows[s].size(), 0);
+  }
+  RunChunked(plan.rows, "node_type", [&](int s, int32_t b, int32_t e) {
+    std::vector<uint64_t> sub(static_cast<size_t>(e - b));
+    for (int32_t j = b; j < e; ++j) sub[j - b] = ids[plan.rows[s][j]];
     WireWriter req;
     req.U8(kNodeType);
     req.Arr(sub);
@@ -611,24 +752,33 @@ void RemoteGraph::GetNodeType(const uint64_t* ids, int n,
     int64_t m;
     const int32_t* t = r.Arr<int32_t>(&m);
     if (!r.ok() || m != static_cast<int64_t>(sub.size())) return false;
-    for (int64_t j = 0; j < m; ++j) out[rows[s][j]] = t[j];
+    for (int64_t j = 0; j < m; ++j) {
+      got[s][b + j] = t[j];
+      ok[s][b + j] = 1;
+    }
     return true;
   });
+  for (int i = 0; i < n; ++i) {
+    int s = plan.shard_of[i];
+    if (s >= 0 && ok[s][plan.pos_of[i]]) out[i] = got[s][plan.pos_of[i]];
+  }
 }
 
 bool RemoteGraph::GetNodeWeight(const uint64_t* ids, int n,
                                 float* out) const {
   std::fill(out, out + n, 0.f);
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
-  // Unlike the query ops (which degrade failed rows to defaults), a
-  // weight silently read as 0 would bias the exported device sampler —
-  // so per-shard success is tracked and surfaced.
-  std::vector<char> ok(num_shards_, 1);
-  ForShards(rows, [&](int s) {
-    ok[s] = 0;
-    std::vector<uint64_t> sub(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+  if (n <= 0) return true;
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
+  std::vector<std::vector<float>> got(num_shards_);
+  std::vector<std::vector<char>> ok(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    got[s].assign(plan.rows[s].size(), 0.f);
+    ok[s].assign(plan.rows[s].size(), 0);
+  }
+  RunChunked(plan.rows, "node_weight", [&](int s, int32_t b, int32_t e) {
+    std::vector<uint64_t> sub(static_cast<size_t>(e - b));
+    for (int32_t j = b; j < e; ++j) sub[j - b] = ids[plan.rows[s][j]];
     WireWriter req;
     req.U8(kNodeWeight);
     req.Arr(sub);
@@ -639,12 +789,22 @@ bool RemoteGraph::GetNodeWeight(const uint64_t* ids, int n,
     int64_t m;
     const float* w = r.Arr<float>(&m);
     if (!r.ok() || m != static_cast<int64_t>(sub.size())) return false;
-    for (int64_t j = 0; j < m; ++j) out[rows[s][j]] = w[j];
-    ok[s] = 1;
+    for (int64_t j = 0; j < m; ++j) {
+      got[s][b + j] = w[j];
+      ok[s][b + j] = 1;
+    }
     return true;
   });
+  // Unlike the query ops (which degrade failed rows to defaults), a
+  // weight silently read as 0 would bias the exported device sampler —
+  // so any missing unique row fails the whole batch.
   for (int s = 0; s < num_shards_; ++s)
-    if (!rows[s].empty() && !ok[s]) return false;
+    for (char f : ok[s])
+      if (!f) return false;
+  for (int i = 0; i < n; ++i) {
+    int s = plan.shard_of[i];
+    if (s >= 0) out[i] = got[s][plan.pos_of[i]];
+  }
   return true;
 }
 
@@ -654,7 +814,7 @@ void RemoteGraph::SampleNodeWithSrc(const uint64_t* src, int n, int count,
   // `count` nodes from the global sampler of the src node's type (type -1 —
   // missing src — falls back to the all-types sampler). Remotely: resolve
   // src types, draw a shard per (row, draw) from that type's cross-shard
-  // table, batch one SampleNode per (shard, type).
+  // table, batch one SampleNode per (shard, type) on the dispatcher.
   std::vector<int32_t> types(n);
   GetNodeType(src, n, types.data());
   Rng& rng = ThreadRng();
@@ -671,29 +831,36 @@ void RemoteGraph::SampleNodeWithSrc(const uint64_t* src, int n, int count,
       groups[{s, t}].push_back(static_cast<int64_t>(i) * count + j);
     }
   }
-  std::vector<std::thread> ts;
+  std::vector<std::function<void()>> jobs;
+  jobs.reserve(groups.size());
   for (auto& [key, slots] : groups) {
-    ts.emplace_back([this, &key = key, &slots = slots, out] {
+    jobs.emplace_back([this, &key = key, &slots = slots, out] {
+      bool ok = false;
       try {
         WireWriter req;
         req.U8(kSampleNode);
         req.I32(static_cast<int32_t>(slots.size()));
         req.I32(key.second);
         std::string reply;
-        if (!Call(key.first, req.buf(), &reply)) return;
-        WireReader r(reply);
-        r.U8();
-        int64_t m;
-        const uint64_t* ids = r.Arr<uint64_t>(&m);
-        if (!r.ok() || m != static_cast<int64_t>(slots.size())) return;
-        for (int64_t j = 0; j < m; ++j) out[slots[j]] = ids[j];
+        if (Call(key.first, req.buf(), &reply)) {
+          WireReader r(reply);
+          r.U8();
+          int64_t m;
+          const uint64_t* ids = r.Arr<uint64_t>(&m);
+          if (r.ok() && m == static_cast<int64_t>(slots.size())) {
+            for (int64_t j = 0; j < m; ++j) out[slots[j]] = ids[j];
+            ok = true;
+          }
+        }
       } catch (...) {
-        // std::terminate barrier (eg-lint: thread-catch): this group's
-        // slots keep their prefilled zeros, like a failed Call
+        // this group's slots keep their prefilled zeros, like a failed
+        // Call (the failure is recorded below)
+        ok = false;
       }
+      if (!ok) ShardFailed(key.first, "sample_node_with_src");
     });
   }
-  for (auto& t : ts) t.join();
+  dispatcher_->Run(jobs);
 }
 
 void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
@@ -704,14 +871,49 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
   std::fill(out_ids, out_ids + total, default_id);
   std::fill(out_w, out_w + total, 0.f);
   std::fill(out_t, out_t + total, -1);
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> sub(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+  if (n <= 0 || count <= 0) return;
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
+  // Per-shard staging over the unique entries' draw blocks: unique entry
+  // j owns reps[j] * count contiguous draws at rep_off[j] * count; each
+  // original row takes the block at (rep_off[pos] + occ) * count, so
+  // duplicate rows receive DISTINCT (still iid) draws.
+  std::vector<std::vector<int64_t>> rep_off(num_shards_);
+  std::vector<std::vector<uint64_t>> sid(num_shards_);
+  std::vector<std::vector<float>> sw(num_shards_);
+  std::vector<std::vector<int32_t>> st(num_shards_);
+  std::vector<std::vector<char>> ok(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    size_t m = plan.rows[s].size();
+    rep_off[s].assign(m + 1, 0);
+    for (size_t j = 0; j < m; ++j)
+      rep_off[s][j + 1] = rep_off[s][j] + plan.reps[s][j];
+    size_t draws = static_cast<size_t>(rep_off[s][m]) * count;
+    sid[s].assign(draws, default_id);
+    sw[s].assign(draws, 0.f);
+    st[s].assign(draws, -1);
+    ok[s].assign(m, 0);
+  }
+  RunChunked(plan.rows, "sample_neighbor", [&](int s, int32_t b, int32_t e) {
+    int32_t m = e - b;
+    std::vector<uint64_t> sub(static_cast<size_t>(m));
+    std::vector<int32_t> subreps(static_cast<size_t>(m));
+    for (int32_t j = b; j < e; ++j) {
+      sub[j - b] = ids[plan.rows[s][j]];
+      subreps[j - b] = plan.reps[s][j];
+    }
     WireWriter req;
-    req.U8(kSampleNeighbor);
-    req.Arr(sub);
+    if (coalesce_) {
+      // dedup'd form: each unique id once, with its repeat count
+      req.U8(kSampleNeighborUniq);
+      req.Arr(sub);
+      req.Arr(subreps);
+    } else {
+      // pre-dedup wire shape (the bench A/B baseline); reps are all 1
+      // here, so the reply layout is identical
+      req.U8(kSampleNeighbor);
+      req.Arr(sub);
+    }
     req.Arr(etypes, net);
     req.I32(count);
     req.U64(default_id);
@@ -719,21 +921,32 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
     if (!Call(s, req.buf(), &reply)) return false;
     WireReader r(reply);
     r.U8();
-    int64_t m, mw, mt;
-    const uint64_t* rid = r.Arr<uint64_t>(&m);
+    int64_t mi, mw, mt;
+    const uint64_t* rid = r.Arr<uint64_t>(&mi);
     const float* rw = r.Arr<float>(&mw);
     const int32_t* rt = r.Arr<int32_t>(&mt);
-    int64_t want = static_cast<int64_t>(sub.size()) * count;
-    if (!r.ok() || m != want || mw != want || mt != want) return false;
-    for (size_t j = 0; j < rows[s].size(); ++j) {
-      int64_t dst_off = static_cast<int64_t>(rows[s][j]) * count;
-      int64_t src_off = static_cast<int64_t>(j) * count;
-      std::copy(rid + src_off, rid + src_off + count, out_ids + dst_off);
-      std::copy(rw + src_off, rw + src_off + count, out_w + dst_off);
-      std::copy(rt + src_off, rt + src_off + count, out_t + dst_off);
-    }
+    int64_t want = (rep_off[s][e] - rep_off[s][b]) * count;
+    if (!r.ok() || mi != want || mw != want || mt != want) return false;
+    int64_t dst = rep_off[s][b] * count;
+    std::copy(rid, rid + want, sid[s].begin() + dst);
+    std::copy(rw, rw + want, sw[s].begin() + dst);
+    std::copy(rt, rt + want, st[s].begin() + dst);
+    for (int32_t j = b; j < e; ++j) ok[s][j] = 1;
     return true;
   });
+  for (int i = 0; i < n; ++i) {
+    int s = plan.shard_of[i];
+    int32_t pos = plan.pos_of[i];
+    if (s < 0 || !ok[s][pos]) continue;
+    int64_t src_off = (rep_off[s][pos] + plan.occ_of[i]) * count;
+    int64_t dst_off = static_cast<int64_t>(i) * count;
+    std::copy(sid[s].begin() + src_off, sid[s].begin() + src_off + count,
+              out_ids + dst_off);
+    std::copy(sw[s].begin() + src_off, sw[s].begin() + src_off + count,
+              out_w + dst_off);
+    std::copy(st[s].begin() + src_off, st[s].begin() + src_off + count,
+              out_t + dst_off);
+  }
 }
 
 void RemoteGraph::SampleFanout(const uint64_t* ids, int n,
@@ -745,9 +958,18 @@ void RemoteGraph::SampleFanout(const uint64_t* ids, int n,
   const uint64_t* cur = ids;
   int64_t cur_n = n;
   const int32_t* et = etypes_flat;
+  // n * prod(counts) passes 2^31 at deep fanouts; the old
+  // static_cast<int>(cur_n) silently truncated there. Issue each hop in
+  // INT_MAX-bounded slices instead — the per-row scatter makes slicing
+  // invisible to the result.
+  const int64_t kSlice = int64_t{1} << 30;
   for (int h = 0; h < nhops; ++h) {
-    SampleNeighbor(cur, static_cast<int>(cur_n), et, etype_counts[h],
-                   counts[h], default_id, out_ids[h], out_w[h], out_t[h]);
+    for (int64_t off = 0; off < cur_n; off += kSlice) {
+      int m = static_cast<int>(std::min<int64_t>(kSlice, cur_n - off));
+      SampleNeighbor(cur + off, m, et, etype_counts[h], counts[h],
+                     default_id, out_ids[h] + off * counts[h],
+                     out_w[h] + off * counts[h], out_t[h] + off * counts[h]);
+    }
     cur = out_ids[h];
     cur_n *= counts[h];
     et += etype_counts[h];
@@ -755,18 +977,6 @@ void RemoteGraph::SampleFanout(const uint64_t* ids, int n,
 }
 
 namespace {
-
-// Invert rows[s] lists into per-row (shard, position-within-shard) maps.
-void RowOwners(const std::vector<std::vector<int32_t>>& rows, int n,
-               std::vector<int32_t>* shard_of, std::vector<int32_t>* pos_of) {
-  shard_of->assign(n, -1);
-  pos_of->assign(n, 0);
-  for (size_t s = 0; s < rows.size(); ++s)
-    for (size_t j = 0; j < rows[s].size(); ++j) {
-      (*shard_of)[rows[s][j]] = static_cast<int32_t>(s);
-      (*pos_of)[rows[s][j]] = static_cast<int32_t>(j);
-    }
-}
 
 // Prefix offsets of a counts array.
 std::vector<int64_t> Offsets(const std::vector<int32_t>& counts) {
@@ -777,16 +987,15 @@ std::vector<int64_t> Offsets(const std::vector<int32_t>& counts) {
 
 }  // namespace
 
-EGResult* RemoteGraph::MergeFullNeighbor(
-    const std::vector<std::vector<int32_t>>& rows, std::vector<EGResult>& sub,
-    const std::vector<char>& ok, int n) const {
+EGResult* RemoteGraph::MergeFullNeighbor(const ShardPlan& plan,
+                                         std::vector<EGResult>& sub,
+                                         const std::vector<char>& ok,
+                                         int n) const {
   auto* res = new EGResult();
   res->u64.resize(1);
   res->f32.resize(1);
   res->i32.resize(2);
   res->i32[1].assign(n, 0);
-  std::vector<int32_t> shard_of, pos_of;
-  RowOwners(rows, n, &shard_of, &pos_of);
   std::vector<std::vector<int64_t>> off(num_shards_);
   for (int s = 0; s < num_shards_; ++s) {
     // Validate reply shape before trusting its counts — a malformed shard
@@ -794,7 +1003,7 @@ EGResult* RemoteGraph::MergeFullNeighbor(
     // checks.
     if (!ok[s] || sub[s].i32.size() != 2 || sub[s].u64.size() != 1 ||
         sub[s].f32.size() != 1 ||
-        sub[s].i32[1].size() != rows[s].size())
+        sub[s].i32[1].size() != plan.rows[s].size())
       continue;
     auto o = Offsets(sub[s].i32[1]);
     size_t total = static_cast<size_t>(o.back());
@@ -804,9 +1013,9 @@ EGResult* RemoteGraph::MergeFullNeighbor(
     off[s] = std::move(o);
   }
   for (int i = 0; i < n; ++i) {
-    int s = shard_of[i];
+    int s = plan.shard_of[i];
     if (s < 0 || !ok[s] || off[s].empty()) continue;  // defaults: count 0
-    int32_t j = pos_of[i];
+    int32_t j = plan.pos_of[i];  // duplicates share their unique segment
     int64_t b = off[s][j], e = off[s][j + 1];
     res->i32[1][i] = static_cast<int32_t>(e - b);
     res->u64[0].insert(res->u64[0].end(), sub[s].u64[0].begin() + b,
@@ -819,22 +1028,21 @@ EGResult* RemoteGraph::MergeFullNeighbor(
   return res;
 }
 
-EGResult* RemoteGraph::MergeSlotted(
-    const std::vector<std::vector<int32_t>>& rows, std::vector<EGResult>& sub,
-    const std::vector<char>& ok, int n, int nf, bool u64_vals,
-    bool byte_vals) const {
+EGResult* RemoteGraph::MergeSlotted(const ShardPlan& plan,
+                                    std::vector<EGResult>& sub,
+                                    const std::vector<char>& ok, int n,
+                                    int nf, bool u64_vals,
+                                    bool byte_vals) const {
   auto* res = new EGResult();
   res->i32.resize(nf);
   if (u64_vals) res->u64.resize(nf);
   if (byte_vals) res->bytes.resize(nf);
-  std::vector<int32_t> shard_of, pos_of;
-  RowOwners(rows, n, &shard_of, &pos_of);
   for (int k = 0; k < nf; ++k) {
     res->i32[k].assign(n, 0);
     std::vector<std::vector<int64_t>> off(num_shards_);
     for (int s = 0; s < num_shards_; ++s) {
       if (!ok[s] || static_cast<int>(sub[s].i32.size()) != nf ||
-          sub[s].i32[k].size() != rows[s].size())
+          sub[s].i32[k].size() != plan.rows[s].size())
         continue;
       if (u64_vals && static_cast<int>(sub[s].u64.size()) != nf) continue;
       if (byte_vals && static_cast<int>(sub[s].bytes.size()) != nf) continue;
@@ -845,9 +1053,9 @@ EGResult* RemoteGraph::MergeSlotted(
       off[s] = std::move(o);
     }
     for (int i = 0; i < n; ++i) {
-      int s = shard_of[i];
+      int s = plan.shard_of[i];
       if (s < 0 || !ok[s] || off[s].empty()) continue;
-      int32_t j = pos_of[i];
+      int32_t j = plan.pos_of[i];
       int64_t b = off[s][j], e = off[s][j + 1];
       res->i32[k][i] = static_cast<int32_t>(e - b);
       if (u64_vals)
@@ -864,13 +1072,17 @@ EGResult* RemoteGraph::MergeSlotted(
 EGResult* RemoteGraph::GetFullNeighbor(const uint64_t* ids, int n,
                                        const int32_t* etypes, int net,
                                        bool sorted) const {
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
   std::vector<EGResult> sub(num_shards_);
   std::vector<char> ok(num_shards_, 0);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> subids(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) subids[j] = ids[rows[s][j]];
+  // Variable-length replies stay one call per shard (chunking them would
+  // need segment stitching for little gain: the dedup above already
+  // removed the duplicate rows that dominate power-law batches).
+  ForShards(plan.rows, "full_neighbor", [&](int s) {
+    std::vector<uint64_t> subids(plan.rows[s].size());
+    for (size_t j = 0; j < plan.rows[s].size(); ++j)
+      subids[j] = ids[plan.rows[s][j]];
     WireWriter req;
     req.U8(kFullNeighbor);
     req.Arr(subids);
@@ -885,7 +1097,7 @@ EGResult* RemoteGraph::GetFullNeighbor(const uint64_t* ids, int n,
     return true;
   });
   // Engine layout: u64[0]=ids, f32[0]=weights, i32[0]=types, i32[1]=counts.
-  return MergeFullNeighbor(rows, sub, ok, n);
+  return MergeFullNeighbor(plan, sub, ok, n);
 }
 
 void RemoteGraph::GetTopKNeighbor(const uint64_t* ids, int n,
@@ -896,11 +1108,24 @@ void RemoteGraph::GetTopKNeighbor(const uint64_t* ids, int n,
   std::fill(out_ids, out_ids + total, default_id);
   std::fill(out_w, out_w + total, 0.f);
   std::fill(out_t, out_t + total, -1);
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> sub(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+  if (n <= 0 || k <= 0) return;
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
+  // Deterministic per id, so duplicates simply copy the unique reply row.
+  std::vector<std::vector<uint64_t>> sid(num_shards_);
+  std::vector<std::vector<float>> sw(num_shards_);
+  std::vector<std::vector<int32_t>> st(num_shards_);
+  std::vector<std::vector<char>> ok(num_shards_);
+  for (int s = 0; s < num_shards_; ++s) {
+    size_t m = plan.rows[s].size();
+    sid[s].assign(m * k, default_id);
+    sw[s].assign(m * k, 0.f);
+    st[s].assign(m * k, -1);
+    ok[s].assign(m, 0);
+  }
+  RunChunked(plan.rows, "topk_neighbor", [&](int s, int32_t b, int32_t e) {
+    std::vector<uint64_t> sub(static_cast<size_t>(e - b));
+    for (int32_t j = b; j < e; ++j) sub[j - b] = ids[plan.rows[s][j]];
     WireWriter req;
     req.U8(kTopKNeighbor);
     req.Arr(sub);
@@ -911,21 +1136,31 @@ void RemoteGraph::GetTopKNeighbor(const uint64_t* ids, int n,
     if (!Call(s, req.buf(), &reply)) return false;
     WireReader r(reply);
     r.U8();
-    int64_t m, mw, mt;
-    const uint64_t* rid = r.Arr<uint64_t>(&m);
+    int64_t mi, mw, mt;
+    const uint64_t* rid = r.Arr<uint64_t>(&mi);
     const float* rw = r.Arr<float>(&mw);
     const int32_t* rt = r.Arr<int32_t>(&mt);
     int64_t want = static_cast<int64_t>(sub.size()) * k;
-    if (!r.ok() || m != want || mw != want || mt != want) return false;
-    for (size_t j = 0; j < rows[s].size(); ++j) {
-      int64_t dst_off = static_cast<int64_t>(rows[s][j]) * k;
-      int64_t src_off = static_cast<int64_t>(j) * k;
-      std::copy(rid + src_off, rid + src_off + k, out_ids + dst_off);
-      std::copy(rw + src_off, rw + src_off + k, out_w + dst_off);
-      std::copy(rt + src_off, rt + src_off + k, out_t + dst_off);
-    }
+    if (!r.ok() || mi != want || mw != want || mt != want) return false;
+    std::copy(rid, rid + want, sid[s].begin() + static_cast<int64_t>(b) * k);
+    std::copy(rw, rw + want, sw[s].begin() + static_cast<int64_t>(b) * k);
+    std::copy(rt, rt + want, st[s].begin() + static_cast<int64_t>(b) * k);
+    for (int32_t j = b; j < e; ++j) ok[s][j] = 1;
     return true;
   });
+  for (int i = 0; i < n; ++i) {
+    int s = plan.shard_of[i];
+    int32_t pos = plan.pos_of[i];
+    if (s < 0 || !ok[s][pos]) continue;
+    int64_t src_off = static_cast<int64_t>(pos) * k;
+    int64_t dst_off = static_cast<int64_t>(i) * k;
+    std::copy(sid[s].begin() + src_off, sid[s].begin() + src_off + k,
+              out_ids + dst_off);
+    std::copy(sw[s].begin() + src_off, sw[s].begin() + src_off + k,
+              out_w + dst_off);
+    std::copy(st[s].begin() + src_off, st[s].begin() + src_off + k,
+              out_t + dst_off);
+  }
 }
 
 void RemoteGraph::RandomWalk(const uint64_t* ids, int n,
@@ -952,6 +1187,7 @@ void RemoteGraph::RandomWalk(const uint64_t* ids, int n,
       // neighbor lists, d_tx weights w/p (return), w (distance 1), w/q
       // (distance 2) — semantics of reference euler/client/graph.cc:120-151,
       // which likewise issues two GetSortedFullNeighbor scatters per hop.
+      // Walks revisit hubs constantly, so both fetches ride the dedup path.
       EGResult* cn = GetFullNeighbor(cur.data(), n, et, net, true);
       EGResult* pn = GetFullNeighbor(parent.data(), n, et, net, true);
       const auto& c_ids = cn->u64[0];
@@ -1012,11 +1248,43 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
   int64_t row_dim = 0;
   for (int k = 0; k < nf; ++k) row_dim += dims[k];
   std::fill(out, out + static_cast<int64_t>(n) * row_dim, 0.f);
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> sub(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) sub[j] = ids[rows[s][j]];
+  if (n <= 0 || row_dim <= 0) return;
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
+  Counters& ctr = Counters::Global();
+  const bool use_cache = fcache_.enabled();
+  const uint64_t spec =
+      use_cache ? FeatureCache::SpecHash(fids, dims, nf) : 0;
+  // Staging over unique entries; cache hits fill their rows up front and
+  // drop out of the fetch lists entirely (zero wire bytes).
+  std::vector<std::vector<float>> sval(num_shards_);
+  std::vector<std::vector<char>> ok(num_shards_);
+  std::vector<std::vector<int32_t>> fetch(num_shards_);
+  uint64_t hits = 0, misses = 0;
+  for (int s = 0; s < num_shards_; ++s) {
+    size_t m = plan.rows[s].size();
+    sval[s].assign(m * static_cast<size_t>(row_dim), 0.f);
+    ok[s].assign(m, 0);
+    for (size_t j = 0; j < m; ++j) {
+      uint64_t id = ids[plan.rows[s][j]];
+      if (use_cache &&
+          fcache_.Get(spec, id, sval[s].data() + j * row_dim,
+                      static_cast<size_t>(row_dim))) {
+        ok[s][j] = 1;
+        ++hits;
+      } else {
+        fetch[s].push_back(static_cast<int32_t>(j));
+        if (use_cache) ++misses;
+      }
+    }
+  }
+  if (hits) ctr.Add(kCtrCacheHit, hits);
+  if (misses) ctr.Add(kCtrCacheMiss, misses);
+  RunChunked(fetch, "dense_feature", [&](int s, int32_t b, int32_t e) {
+    int32_t m = e - b;
+    std::vector<uint64_t> sub(static_cast<size_t>(m));
+    for (int32_t x = 0; x < m; ++x)
+      sub[x] = ids[plan.rows[s][fetch[s][b + x]]];
     WireWriter req;
     req.U8(kDenseFeature);
     req.Arr(sub);
@@ -1026,15 +1294,30 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
     if (!Call(s, req.buf(), &reply)) return false;
     WireReader r(reply);
     r.U8();
-    int64_t m;
-    const float* vals = r.Arr<float>(&m);
-    if (!r.ok() || m != static_cast<int64_t>(sub.size()) * row_dim)
-      return false;
-    for (size_t j = 0; j < rows[s].size(); ++j)
-      std::copy(vals + j * row_dim, vals + (j + 1) * row_dim,
-                out + static_cast<int64_t>(rows[s][j]) * row_dim);
+    int64_t mm;
+    const float* vals = r.Arr<float>(&mm);
+    if (!r.ok() || mm != static_cast<int64_t>(m) * row_dim) return false;
+    for (int32_t x = 0; x < m; ++x) {
+      int32_t j = fetch[s][b + x];
+      std::copy(vals + static_cast<int64_t>(x) * row_dim,
+                vals + static_cast<int64_t>(x + 1) * row_dim,
+                sval[s].begin() + static_cast<int64_t>(j) * row_dim);
+      ok[s][j] = 1;
+      if (use_cache)
+        fcache_.Put(spec, sub[x], vals + static_cast<int64_t>(x) * row_dim,
+                    static_cast<size_t>(row_dim));
+    }
     return true;
   });
+  for (int i = 0; i < n; ++i) {
+    int s = plan.shard_of[i];
+    if (s < 0) continue;
+    int32_t pos = plan.pos_of[i];
+    if (!ok[s][pos]) continue;
+    std::copy(sval[s].begin() + static_cast<int64_t>(pos) * row_dim,
+              sval[s].begin() + static_cast<int64_t>(pos + 1) * row_dim,
+              out + static_cast<int64_t>(i) * row_dim);
+  }
 }
 
 void RemoteGraph::GetEdgeDenseFeature(const uint64_t* src,
@@ -1048,10 +1331,11 @@ void RemoteGraph::GetEdgeDenseFeature(const uint64_t* src,
   std::fill(out, out + static_cast<int64_t>(n) * row_dim, 0.f);
   // Edges live on the shard of their src node (the converter emits edge
   // records inside the src node's block — see convert.py / reference
-  // euler/tools/json2dat.py:139).
+  // euler/tools/json2dat.py:139). Edge identity is the (src, dst, type)
+  // triple, so the node-id dedup/cache does not apply here.
   std::vector<std::vector<int32_t>> rows;
   GroupByShard(src, n, &rows);
-  ForShards(rows, [&](int s) {
+  ForShards(rows, "edge_dense_feature", [&](int s) {
     size_t m = rows[s].size();
     std::vector<uint64_t> ssrc(m), sdst(m);
     std::vector<int32_t> st(m);
@@ -1083,13 +1367,14 @@ void RemoteGraph::GetEdgeDenseFeature(const uint64_t* src,
 
 EGResult* RemoteGraph::GetSparseFeature(const uint64_t* ids, int n,
                                         const int32_t* fids, int nf) const {
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
   std::vector<EGResult> sub(num_shards_);
   std::vector<char> ok(num_shards_, 0);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> subids(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) subids[j] = ids[rows[s][j]];
+  ForShards(plan.rows, "sparse_feature", [&](int s) {
+    std::vector<uint64_t> subids(plan.rows[s].size());
+    for (size_t j = 0; j < plan.rows[s].size(); ++j)
+      subids[j] = ids[plan.rows[s][j]];
     WireWriter req;
     req.U8(kSparseFeature);
     req.Arr(subids);
@@ -1103,7 +1388,7 @@ EGResult* RemoteGraph::GetSparseFeature(const uint64_t* ids, int n,
     return true;
   });
   // Layout: u64[k]=values of slot k, i32[k]=per-row counts (nf slots each).
-  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/true, /*bytes=*/false);
+  return MergeSlotted(plan, sub, ok, n, nf, /*u64=*/true, /*bytes=*/false);
 }
 
 EGResult* RemoteGraph::GetEdgeSparseFeature(const uint64_t* src,
@@ -1111,18 +1396,18 @@ EGResult* RemoteGraph::GetEdgeSparseFeature(const uint64_t* src,
                                             const int32_t* types, int n,
                                             const int32_t* fids,
                                             int nf) const {
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(src, n, &rows);
+  ShardPlan plan;
+  BuildEdgePlan(src, n, &plan);
   std::vector<EGResult> sub(num_shards_);
   std::vector<char> ok(num_shards_, 0);
-  ForShards(rows, [&](int s) {
-    size_t m = rows[s].size();
+  ForShards(plan.rows, "edge_sparse_feature", [&](int s) {
+    size_t m = plan.rows[s].size();
     std::vector<uint64_t> ssrc(m), sdst(m);
     std::vector<int32_t> st(m);
     for (size_t j = 0; j < m; ++j) {
-      ssrc[j] = src[rows[s][j]];
-      sdst[j] = dst[rows[s][j]];
-      st[j] = types[rows[s][j]];
+      ssrc[j] = src[plan.rows[s][j]];
+      sdst[j] = dst[plan.rows[s][j]];
+      st[j] = types[plan.rows[s][j]];
     }
     WireWriter req;
     req.U8(kEdgeSparseFeature);
@@ -1138,18 +1423,19 @@ EGResult* RemoteGraph::GetEdgeSparseFeature(const uint64_t* src,
     ok[s] = 1;
     return true;
   });
-  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/true, /*bytes=*/false);
+  return MergeSlotted(plan, sub, ok, n, nf, /*u64=*/true, /*bytes=*/false);
 }
 
 EGResult* RemoteGraph::GetBinaryFeature(const uint64_t* ids, int n,
                                         const int32_t* fids, int nf) const {
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(ids, n, &rows);
+  ShardPlan plan;
+  BuildPlan(ids, n, &plan);
   std::vector<EGResult> sub(num_shards_);
   std::vector<char> ok(num_shards_, 0);
-  ForShards(rows, [&](int s) {
-    std::vector<uint64_t> subids(rows[s].size());
-    for (size_t j = 0; j < rows[s].size(); ++j) subids[j] = ids[rows[s][j]];
+  ForShards(plan.rows, "binary_feature", [&](int s) {
+    std::vector<uint64_t> subids(plan.rows[s].size());
+    for (size_t j = 0; j < plan.rows[s].size(); ++j)
+      subids[j] = ids[plan.rows[s][j]];
     WireWriter req;
     req.U8(kBinaryFeature);
     req.Arr(subids);
@@ -1162,7 +1448,7 @@ EGResult* RemoteGraph::GetBinaryFeature(const uint64_t* ids, int n,
     ok[s] = 1;
     return true;
   });
-  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/false, /*bytes=*/true);
+  return MergeSlotted(plan, sub, ok, n, nf, /*u64=*/false, /*bytes=*/true);
 }
 
 EGResult* RemoteGraph::GetEdgeBinaryFeature(const uint64_t* src,
@@ -1170,18 +1456,18 @@ EGResult* RemoteGraph::GetEdgeBinaryFeature(const uint64_t* src,
                                             const int32_t* types, int n,
                                             const int32_t* fids,
                                             int nf) const {
-  std::vector<std::vector<int32_t>> rows;
-  GroupByShard(src, n, &rows);
+  ShardPlan plan;
+  BuildEdgePlan(src, n, &plan);
   std::vector<EGResult> sub(num_shards_);
   std::vector<char> ok(num_shards_, 0);
-  ForShards(rows, [&](int s) {
-    size_t m = rows[s].size();
+  ForShards(plan.rows, "edge_binary_feature", [&](int s) {
+    size_t m = plan.rows[s].size();
     std::vector<uint64_t> ssrc(m), sdst(m);
     std::vector<int32_t> st(m);
     for (size_t j = 0; j < m; ++j) {
-      ssrc[j] = src[rows[s][j]];
-      sdst[j] = dst[rows[s][j]];
-      st[j] = types[rows[s][j]];
+      ssrc[j] = src[plan.rows[s][j]];
+      sdst[j] = dst[plan.rows[s][j]];
+      st[j] = types[plan.rows[s][j]];
     }
     WireWriter req;
     req.U8(kEdgeBinaryFeature);
@@ -1197,7 +1483,7 @@ EGResult* RemoteGraph::GetEdgeBinaryFeature(const uint64_t* src,
     ok[s] = 1;
     return true;
   });
-  return MergeSlotted(rows, sub, ok, n, nf, /*u64=*/false, /*bytes=*/true);
+  return MergeSlotted(plan, sub, ok, n, nf, /*u64=*/false, /*bytes=*/true);
 }
 
 }  // namespace eg

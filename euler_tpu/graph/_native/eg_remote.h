@@ -11,9 +11,23 @@
 // sorted-neighbor merge (graph.cc:120-151), and per-shard replica pools with
 // retry + timed bad-host quarantine (rpc_manager.h:68-122,
 // rpc_client.cc:29-49). Differences: the transport is the zero-dependency
-// wire protocol of eg_wire.h instead of gRPC, calls are batch-synchronous
-// (per-shard fan-out runs on ephemeral threads joined before return), and
-// discovery is the flat-file registry of eg_service.h instead of ZooKeeper.
+// wire protocol of eg_wire.h instead of gRPC, calls are batch-synchronous,
+// and discovery is the flat-file registry of eg_service.h instead of
+// ZooKeeper.
+//
+// Hot-path shape (this file's perf contract, PERF.md "Remote path"):
+//   * scatter/gather runs on a PERSISTENT worker pool (eg_dispatch.h) —
+//     no thread create/join per query; large per-shard requests are
+//     split into `chunk_ids=`-bounded chunks issued concurrently over
+//     multiple pooled sockets (`rpc_chunks` counter);
+//   * duplicate ids are COALESCED before wire encode (`coalesce=1`
+//     default; `ids_deduped` counter) — one wire id and one shard lookup
+//     per unique id, replies scattered back through the row maps; for
+//     SampleNeighbor the kSampleNeighborUniq op carries repeat counts so
+//     duplicate rows still receive independent draws;
+//   * dense feature rows are served from a capacity-bounded client cache
+//     (eg_cache.h, `feature_cache_mb=`, `cache_hits`/`cache_misses`) —
+//     the graph is immutable after load, so cached rows never invalidate.
 #ifndef EG_REMOTE_H_
 #define EG_REMOTE_H_
 
@@ -28,6 +42,8 @@
 #include <vector>
 
 #include "eg_api.h"
+#include "eg_cache.h"
+#include "eg_dispatch.h"
 #include "eg_engine.h"
 #include "eg_sampling.h"
 #include "eg_wire.h"
@@ -71,7 +87,9 @@ class ConnPool {
   // time spent in earlier attempts. Returns false when every attempt
   // failed or the deadline expired (reply undefined). Failure counters
   // (eg_stats.h Counters) record dial failures, retries, quarantines,
-  // failovers, deadline aborts, and exhausted calls.
+  // failovers, deadline aborts, and exhausted calls. Thread-safe: chunked
+  // requests Call the same pool concurrently from several dispatcher
+  // workers, each exchange on its own pooled socket.
   bool Call(const std::string& req, std::string* reply, int retries,
             int timeout_ms, int quarantine_ms, int backoff_ms = 20,
             int deadline_ms = 0) const;
@@ -101,8 +119,22 @@ class RemoteGraph : public GraphAPI {
   //   ConnPools — the reference's ZK watch-children semantics
   //   (zk_server_monitor.cc:252-260 OnAddChild/OnRemoveChild) by polling,
   //   so a shard restarted on a NEW address is re-learned mid-run.
+  // Hot-path keys (all optional):
+  //   coalesce (default 1): dedup duplicate ids before wire encode
+  //     (`ids_deduped`); 0 restores the pre-dedup wire shape (the bench
+  //     A/B baseline),
+  //   feature_cache_mb (default 64; 0 = off): byte budget of the
+  //     client-side dense-feature-row cache (eg_cache.h),
+  //   chunk_ids (default 16384): max unique ids per wire request; larger
+  //     per-shard requests split into concurrent chunks (`rpc_chunks`),
+  //   dispatch_workers (default 0 = auto: min(64, max(8, 2*shards))):
+  //     size of the persistent dispatcher pool,
+  //   strict (default 0): a shard call that fails after all transport
+  //     retries raises through the C ABI (eg_remote_strict_error) instead
+  //     of silently degrading its rows to defaults. Either way the
+  //     failure is counted in `rpc_errors`.
   bool Init(const std::string& config);
-  ~RemoteGraph() override;  // stops the re-discovery thread
+  ~RemoteGraph() override;  // stops the re-discovery thread + dispatcher
   const std::string& error() const { return error_; }
 
   int num_shards() const { return num_shards_; }
@@ -111,6 +143,12 @@ class RemoteGraph : public GraphAPI {
     return shard >= 0 && shard < num_shards_ ? pools_[shard].num_replicas()
                                              : 0;
   }
+  // Pending strict-mode failure: copies + clears the first recorded
+  // message. Empty string = no pending failure. (The fixed-shape query
+  // ABI returns void, so strict failures surface through this side
+  // channel — eg_remote_strict_error — which the Python client polls
+  // after every remote call.)
+  std::string TakeStrictError() const;
 
   // ---- GraphAPI ----
   int64_t NumNodes() const override { return num_nodes_; }
@@ -167,6 +205,26 @@ class RemoteGraph : public GraphAPI {
                                  const int32_t* fids, int nf) const override;
 
  private:
+  // How one request's ids scatter to shards after (optional) coalescing:
+  // per shard the unique ids' first-occurrence row list plus per-entry
+  // duplicate counts, and for every ORIGINAL row the (shard, unique
+  // position, occurrence index) it resolves to — the row maps replies
+  // scatter back through.
+  struct ShardPlan {
+    std::vector<std::vector<int32_t>> rows;  // [shard] -> unique rows
+    std::vector<std::vector<int32_t>> reps;  // [shard] -> dup count/unique
+    std::vector<int32_t> shard_of;           // [orig row]
+    std::vector<int32_t> pos_of;             // [orig row] -> unique pos
+    std::vector<int32_t> occ_of;             // [orig row] -> occurrence
+    int64_t coalesced = 0;                   // rows removed from the wire
+  };
+  // Build the plan (dedup when coalesce=1; identity grouping otherwise).
+  // Adds `coalesced` to the ids_deduped counter.
+  void BuildPlan(const uint64_t* ids, int n, ShardPlan* plan) const;
+  // Identity plan routed by src id, no dedup — the edge ops key on the
+  // (src, dst, type) triple, which node-id coalescing cannot collapse.
+  void BuildEdgePlan(const uint64_t* src, int n, ShardPlan* plan) const;
+
   // One pass of discovery from the recorded source (tcp registry LIST or
   // flat-dir scan) into shard -> replica address lists. False when the
   // source is unreachable (callers keep the current pools). timeout_ms
@@ -183,28 +241,42 @@ class RemoteGraph : public GraphAPI {
     return static_cast<int>((id % static_cast<uint64_t>(num_partitions_)) %
                             static_cast<uint64_t>(num_shards_));
   }
-  // rows[s] = ascending list of row indices owned by shard s.
+  // rows[s] = ascending list of row indices owned by shard s (no dedup;
+  // the edge ops and the fixed global-sampling ops use this form).
   void GroupByShard(const uint64_t* ids, int n,
                     std::vector<std::vector<int32_t>>* rows) const;
-  // Issue req to shard; decode reply past the status byte into *r.
+  // Issue req to shard; decode reply past the status byte into *reply.
   // False on transport failure or error status.
   bool Call(int shard, const std::string& req, std::string* reply) const;
-  // Run fn(s) concurrently for every shard with rows; fn returns false on
-  // failure (affected rows keep their prefilled defaults).
+  // Record a per-shard op failure: rpc_errors counter, plus the pending
+  // strict-mode error under strict=1.
+  void ShardFailed(int shard, const char* what) const;
+  // Run fn(s) on the persistent dispatcher for every shard with rows;
+  // fn returns false on failure (affected rows keep their prefilled
+  // defaults; the failure is counted and, under strict=, recorded).
   void ForShards(const std::vector<std::vector<int32_t>>& rows,
+                 const char* what,
                  const std::function<bool(int)>& fn) const;
+  // Run chunk_fn(s, b, e) over [b, e) slices of lists[s] on the
+  // dispatcher, splitting slices longer than chunk_ids_ into concurrent
+  // chunks (counted in rpc_chunks when a shard's list splits).
+  void RunChunked(const std::vector<std::vector<int32_t>>& lists,
+                  const char* what,
+                  const std::function<bool(int, int32_t, int32_t)>& chunk_fn)
+      const;
   // Weighted multinomial draw of a shard per sample; type==-1 uses totals.
   void DrawShards(bool edges, int32_t type, int count, int* out) const;
   // Gather merges for variable-length sub-results (ordered re-assembly, the
-  // role of the reference's MergeCallback, remote_graph.cc:241-261).
+  // role of the reference's MergeCallback, remote_graph.cc:241-261),
+  // scattering each shard's per-unique-row segments back to every
+  // original row through the plan's row maps.
   // FullNeighbor layout: u64[0]/f32[0]/i32[0] values + i32[1] row counts.
-  EGResult* MergeFullNeighbor(const std::vector<std::vector<int32_t>>& rows,
+  EGResult* MergeFullNeighbor(const ShardPlan& plan,
                               std::vector<EGResult>& sub,
                               const std::vector<char>& ok, int n) const;
   // Sparse/binary features: nf slots, values in u64[k] or bytes[k], row
   // counts in i32[k].
-  EGResult* MergeSlotted(const std::vector<std::vector<int32_t>>& rows,
-                         std::vector<EGResult>& sub,
+  EGResult* MergeSlotted(const ShardPlan& plan, std::vector<EGResult>& sub,
                          const std::vector<char>& ok, int n, int nf,
                          bool u64_vals, bool byte_vals) const;
 
@@ -212,6 +284,10 @@ class RemoteGraph : public GraphAPI {
   int num_shards_ = 0, num_partitions_ = 1;
   int retries_ = 3, timeout_ms_ = 5000, quarantine_ms_ = 3000;
   int backoff_ms_ = 20, deadline_ms_ = 0;
+  bool coalesce_ = true;
+  bool strict_ = false;
+  int chunk_ids_ = 16384;
+  int dispatch_workers_ = 0;  // 0 = auto
 
   // discovery source recorded by Init for the periodic re-LIST
   // (empty reg_host_ AND empty reg_dir_ = static shards=, no re-discovery)
@@ -230,6 +306,14 @@ class RemoteGraph : public GraphAPI {
   std::vector<std::vector<float>> shard_node_wsum_, shard_edge_wsum_;
 
   std::vector<ConnPool> pools_;
+  // Persistent scatter/gather pool (created by Init once the shard count
+  // is known; jobs are leaf encode/Call/decode closures).
+  std::unique_ptr<Dispatcher> dispatcher_;
+  // Client-side dense-feature-row cache (safe to mutate from const query
+  // methods: internally striped-locked).
+  mutable FeatureCache fcache_;
+  mutable std::mutex strict_mu_;        // guards strict_error_
+  mutable std::string strict_error_;    // first pending strict failure
   // Cross-shard samplers: per type a table over shards, plus totals tables.
   std::vector<PrefixTable> node_shard_by_type_, edge_shard_by_type_;
   PrefixTable node_shard_total_, edge_shard_total_;

@@ -351,6 +351,52 @@ void Service::Dispatch(const std::string& req, std::string* reply) const {
       w.Arr(ot);
       break;
     }
+    case kSampleNeighborUniq: {
+      // Dedup'd neighbor sampling (see eg_wire.h): ids are unique,
+      // reps[i] repeats each; the engine is called once per unique id
+      // with reps[i] * count draws, so the node/group lookup happens
+      // once per unique id while every draw stays iid.
+      int64_t n, nr, net;
+      const uint64_t* ids = r.Arr<uint64_t>(&n);
+      const int32_t* reps = r.Arr<int32_t>(&nr);
+      const int32_t* etypes = r.Arr<int32_t>(&net);
+      int32_t count = r.I32();
+      uint64_t def = r.U64();
+      int64_t total = 0;
+      bool shape_ok = r.ok() && nr == n && count >= 0;
+      for (int64_t i = 0; shape_ok && i < n; ++i) {
+        if (reps[i] < 1) {
+          shape_ok = false;
+          break;
+        }
+        total += static_cast<int64_t>(reps[i]) * count;
+        if (total > static_cast<int64_t>(kMaxFrame)) break;  // rejected below
+      }
+      if (!shape_ok) {
+        WireWriter e;
+        e.U8(1);
+        e.Str("malformed request for op " + std::to_string(op));
+        *reply = std::move(e.buf());
+        return;
+      }
+      if (OversizedResult(3 * total, reply)) return;
+      std::vector<uint64_t> oid(static_cast<size_t>(total));
+      std::vector<float> ow(static_cast<size_t>(total));
+      std::vector<int32_t> ot(static_cast<size_t>(total));
+      int64_t off = 0;
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t m = static_cast<int64_t>(reps[i]) * count;
+        if (m > 0)
+          engine_.SampleNeighbor(ids + i, 1, etypes, static_cast<int>(net),
+                                 static_cast<int>(m), def, oid.data() + off,
+                                 ow.data() + off, ot.data() + off);
+        off += m;
+      }
+      w.Arr(oid);
+      w.Arr(ow);
+      w.Arr(ot);
+      break;
+    }
     case kFullNeighbor: {
       int64_t n, net;
       const uint64_t* ids = r.Arr<uint64_t>(&n);

@@ -100,6 +100,20 @@ enum CounterId : int {
   kCtrFrameReject,       // oversize/malformed/error-status frame rejected
   kCtrRediscover,        // background registry re-LIST applied to pools
   kCtrHeartbeatMiss,     // a service registry heartbeat that had to redial
+  // Remote hot-path efficiency ledger (perf counters, not failures —
+  // same mechanism so one snapshot covers both): how many ids the
+  // client did NOT have to put on the wire, and how its requests were
+  // shaped. On power-law graphs duplicate hub ids dominate a batch, so
+  // these are the terms of the communication-win accounting
+  // (ids_on_wire_after = ids_requested - ids_deduped - cache_hits).
+  kCtrIdsDeduped,        // duplicate ids coalesced before wire encode
+  kCtrCacheHit,          // feature-row cache hits (per unique id probed)
+  kCtrCacheMiss,         // feature-row cache misses (row fetched remotely)
+  kCtrRpcChunk,          // chunked sub-requests (counted per chunk when a
+                         // per-shard request was split; unsplit adds 0)
+  kCtrRpcError,          // a per-shard op failed after all transport
+                         // retries (its rows degraded to defaults, or the
+                         // call raised under strict=)
   kCtrCount,
 };
 
@@ -107,6 +121,8 @@ const char* const kCounterNames[kCtrCount] = {
     "dials_failed",       "retries",          "quarantines",
     "failovers",          "calls_failed",     "deadlines_exceeded",
     "frames_rejected",    "rediscoveries",    "heartbeat_misses",
+    "ids_deduped",        "cache_hits",       "cache_misses",
+    "rpc_chunks",         "rpc_errors",
 };
 
 class Counters {

@@ -42,6 +42,16 @@ enum WireOp : uint8_t {
   // the device-graph exporter (build_node_sampler) composes with remote
   // mode instead of requiring the whole graph embedded in one process.
   kNodeWeight = 15,
+  // Dedup-aware neighbor sampling: the client coalesces duplicate ids
+  // before encode and sends each UNIQUE id once with a repeat count; the
+  // shard replies reps[i] * count iid draws per unique id, flattened in
+  // request order. Independence across duplicate rows is preserved
+  // (every draw is a fresh engine sample), while hub ids — repeated
+  // thousands of times in a power-law batch — cost one id on the wire
+  // and one node/group lookup on the shard.
+  // Request: [Arr u64 ids][Arr i32 reps][Arr i32 etypes][i32 count][u64 def]
+  // Reply:   [Arr u64 nbr][Arr f32 w][Arr i32 t], each sum(reps)*count long.
+  kSampleNeighborUniq = 16,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap

@@ -124,6 +124,11 @@ class Graph:
         deadline_ms: int | None = None,
         fault: str | None = None,
         fault_seed: int | None = None,
+        feature_cache_mb: int | None = None,
+        strict: bool | None = None,
+        coalesce: bool | None = None,
+        chunk_ids: int | None = None,
+        dispatch_workers: int | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
         config: str | None = None,
@@ -140,7 +145,8 @@ class Graph:
             "directory", "files", "shard_idx", "shard_num", "mode",
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
             "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
-            "fault_seed", "cache_dir", "stream", "init",
+            "fault_seed", "feature_cache_mb", "strict", "coalesce",
+            "chunk_ids", "dispatch_workers", "cache_dir", "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -180,6 +186,23 @@ class Graph:
         # "recv_frame:err@0.5,dial:delay@200"; process-global
         fault = pick("fault", fault, None)
         fault_seed = pick("fault_seed", fault_seed, None)
+        # remote hot-path knobs (native defaults apply when None):
+        # feature_cache_mb (64; 0 off) bounds the client-side dense-
+        # feature-row cache, strict (0) raises on a shard that failed
+        # after all transport retries instead of training on defaults,
+        # coalesce (1) dedups duplicate ids before wire encode,
+        # chunk_ids (16384) splits large per-shard requests into
+        # concurrent chunks, dispatch_workers (auto) sizes the
+        # persistent dispatcher pool
+        feature_cache_mb = pick("feature_cache_mb", feature_cache_mb, None)
+        strict = pick("strict", strict, None)
+        if isinstance(strict, str):
+            strict = str2bool(strict)
+        coalesce = pick("coalesce", coalesce, None)
+        if isinstance(coalesce, str):
+            coalesce = str2bool(coalesce)
+        chunk_ids = pick("chunk_ids", chunk_ids, None)
+        dispatch_workers = pick("dispatch_workers", dispatch_workers, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
@@ -209,6 +232,22 @@ class Graph:
                 "injection use euler_tpu.graph.native.fault_config in "
                 "the shard process)"
             )
+        if mode != "remote":
+            # same loudness rule: these keys configure the remote client's
+            # wire path (dedup, cache, chunking, dispatcher, strict shard
+            # failures); an embedded engine has no wire, so accepting
+            # them would silently do nothing
+            for key, val in (
+                ("feature_cache_mb", feature_cache_mb), ("strict", strict),
+                ("coalesce", coalesce), ("chunk_ids", chunk_ids),
+                ("dispatch_workers", dispatch_workers),
+            ):
+                if val is not None:
+                    raise ValueError(
+                        f"{key}= applies to mode='remote' graphs (it "
+                        "configures the remote client's request path; "
+                        "the embedded engine reads local memory)"
+                    )
         if stream and mode != "local":
             # never dropped silently: remote mode reads no graph data
             # itself, so accepting the flag would just mislead
@@ -226,9 +265,13 @@ class Graph:
             quarantine_ms=quarantine_ms, rediscover_ms=rediscover_ms,
             backoff_ms=backoff_ms, deadline_ms=deadline_ms,
             fault=fault, fault_seed=fault_seed,
+            feature_cache_mb=feature_cache_mb, strict=strict,
+            coalesce=coalesce, chunk_ids=chunk_ids,
+            dispatch_workers=dispatch_workers,
             cache_dir=cache_dir, stream=bool(stream),
         )
         self.mode = mode
+        self._strict = bool(strict) if strict is not None else False
         if init == "eager":
             self._connect()
 
@@ -337,6 +380,16 @@ class Graph:
                 conf += f";backoff_ms={int(p['backoff_ms'])}"
             if p["deadline_ms"] is not None:
                 conf += f";deadline_ms={int(p['deadline_ms'])}"
+            if p["feature_cache_mb"] is not None:
+                conf += f";feature_cache_mb={int(p['feature_cache_mb'])}"
+            if p["strict"] is not None:
+                conf += f";strict={1 if p['strict'] else 0}"
+            if p["coalesce"] is not None:
+                conf += f";coalesce={1 if p['coalesce'] else 0}"
+            if p["chunk_ids"] is not None:
+                conf += f";chunk_ids={int(p['chunk_ids'])}"
+            if p["dispatch_workers"] is not None:
+                conf += f";dispatch_workers={int(p['dispatch_workers'])}"
             if p["fault"] is not None:
                 # ';' is the k=v separator, so the fault grammar uses ','
                 # between failpoints (FAULTS.md)
@@ -402,6 +455,19 @@ class Graph:
             return 1
         return self._lib.eg_remote_replica_count(self._h, shard)
 
+    def _check_strict(self):
+        """Raise the pending strict-mode failure, if any. With
+        ``strict=True`` (remote graphs) a shard call that exhausted every
+        transport retry must surface as an error instead of silently
+        degrading its rows to defaults; the fixed-shape native query ABI
+        returns void, so the failure crosses the C ABI through this poll
+        (eg_remote_strict_error; counted in `rpc_errors`, FAULTS.md)."""
+        if not self._strict:
+            return
+        buf = ctypes.create_string_buffer(512)
+        if self._lib.eg_remote_strict_error(self._handle, buf, 512) > 0:
+            raise RuntimeError(buf.value.decode())
+
     def close(self) -> None:
         # touch _handle, not _h: closing a lazy graph must not connect it
         self._closed = True
@@ -448,6 +514,7 @@ class Graph:
     def sample_node(self, count: int, node_type: int = -1) -> np.ndarray:
         out = np.empty(count, dtype=np.uint64)
         self._lib.eg_sample_node(self._h, count, node_type, _ptr(out, _U64P))
+        self._check_strict()
         return out.view(np.int64)
 
     def sample_edge(self, count: int, edge_type: int = -1):
@@ -458,6 +525,7 @@ class Graph:
             self._h, count, edge_type, _ptr(src, _U64P), _ptr(dst, _U64P),
             _ptr(t, _I32P),
         )
+        self._check_strict()
         return src.view(np.int64), dst.view(np.int64), t
 
     def sample_node_with_src(self, src_ids, count: int) -> np.ndarray:
@@ -467,6 +535,7 @@ class Graph:
         self._lib.eg_sample_node_with_src(
             self._h, _ptr(ids, _U64P), len(ids), count, _ptr(out, _U64P)
         )
+        self._check_strict()
         return out.view(np.int64)
 
     def node_types(self, ids) -> np.ndarray:
@@ -475,6 +544,7 @@ class Graph:
         self._lib.eg_get_node_type(
             self._h, _ptr(ids, _U64P), len(ids), _ptr(out, _I32P)
         )
+        self._check_strict()
         return out
 
     def node_weights(self, ids) -> np.ndarray:
@@ -491,7 +561,12 @@ class Graph:
             self._h, _ptr(ids, _U64P), len(ids), _ptr(out, _F32P)
         )
         if rc != 0:
+            # consume any pending strict record first (same failure, the
+            # shard-naming message) so it cannot go stale and fire on an
+            # unrelated later call
+            self._check_strict()
             raise RuntimeError(self._lib.eg_last_error().decode())
+        self._check_strict()
         return out
 
     # ---- neighbor ops ----
@@ -511,6 +586,7 @@ class Graph:
             _default_u64(default_node), _ptr(out_i, _U64P), _ptr(out_w, _F32P),
             _ptr(out_t, _I32P),
         )
+        self._check_strict()
         return out_i.view(np.int64), out_w, out_t
 
     def sample_fanout(self, ids, edge_types, counts, default_node: int = -1):
@@ -544,6 +620,7 @@ class Graph:
             _ptr(et_counts, _I32P), _ptr(counts_arr, _I32P), nhops,
             _default_u64(default_node), ids_ptrs, w_ptrs, t_ptrs,
         )
+        self._check_strict()
         return (
             [ids.view(np.int64)] + [a.view(np.int64) for a in out_i],
             out_w,
@@ -565,6 +642,7 @@ class Graph:
             counts = self._fetch(r, 2, 1, np.int32)
         finally:
             self._lib.eg_result_free(r)
+        self._check_strict()
         return nbr.view(np.int64), w, t, counts
 
     def get_top_k_neighbor(self, ids, edge_types, k: int, default_node=-1):
@@ -579,6 +657,7 @@ class Graph:
             _default_u64(default_node), _ptr(out_i, _U64P), _ptr(out_w, _F32P),
             _ptr(out_t, _I32P),
         )
+        self._check_strict()
         return out_i.view(np.int64), out_w, out_t
 
     # ---- walks ----
@@ -615,6 +694,7 @@ class Graph:
             _ptr(et_counts, _I32P), walk_len, p, q,
             _default_u64(default_node), _ptr(out, _U64P),
         )
+        self._check_strict()
         return out.view(np.int64)
 
     # ---- features ----
@@ -628,6 +708,7 @@ class Graph:
             self._h, _ptr(ids, _U64P), len(ids), _ptr(fids, _I32P),
             _ptr(dims, _I32P), len(fids), _ptr(out, _F32P),
         )
+        self._check_strict()
         return out
 
     def get_edge_dense_feature(self, src, dst, types, fids, dims) -> np.ndarray:
@@ -642,6 +723,7 @@ class Graph:
             len(src), _ptr(fids, _I32P), _ptr(dims, _I32P), len(fids),
             _ptr(out, _F32P),
         )
+        self._check_strict()
         return out
 
     def get_sparse_feature(self, ids, fids):
@@ -701,9 +783,10 @@ class Graph:
                 vals = self._fetch(r, 0, k, np.uint64).view(np.int64)
                 counts = self._fetch(r, 2, k, np.int32)
                 out.append((vals, counts))
-            return out
         finally:
             self._lib.eg_result_free(r)
+        self._check_strict()
+        return out
 
     def _drain_binary(self, r, nslots: int):
         try:
@@ -721,6 +804,7 @@ class Graph:
                     rows.append(data[off : off + int(s)])
                     off += int(s)
                 out.append(rows)
-            return out
         finally:
             self._lib.eg_result_free(r)
+        self._check_strict()
+        return out

@@ -85,6 +85,7 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_remote_shards, c.c_int, [p])
     _sig(L.eg_remote_partitions, c.c_int, [p])
     _sig(L.eg_remote_replica_count, c.c_int, [p, c.c_int])
+    _sig(L.eg_remote_strict_error, c.c_int, [p, c.c_char_p, c.c_int])
     _sig(
         L.eg_service_start,
         p,
@@ -208,14 +209,18 @@ def stats_reset() -> None:
 
 
 def counters() -> dict:
-    """Snapshot of the native failure counters (process-global, see
-    _native/eg_stats.h Counters): how often the remote transport had to
-    fight for an answer — {"dials_failed": n, "retries": n,
-    "quarantines": n, "failovers": n, "calls_failed": n,
+    """Snapshot of the native counters (process-global, see
+    _native/eg_stats.h Counters). Failure side — how often the remote
+    transport had to fight for an answer: {"dials_failed": n,
+    "retries": n, "quarantines": n, "failovers": n, "calls_failed": n,
     "deadlines_exceeded": n, "frames_rejected": n, "rediscoveries": n,
-    "heartbeat_misses": n}. All keys always present (zero included), so
-    dashboards and the chaos soak can diff snapshots without key
-    existence checks."""
+    "heartbeat_misses": n, "rpc_errors": n}. Efficiency side — the
+    remote hot path's communication-win ledger: {"ids_deduped": n,
+    "cache_hits": n, "cache_misses": n, "rpc_chunks": n}
+    (ids_on_wire = ids_requested - ids_deduped - cache_hits; see
+    FAULTS.md for per-counter semantics). All keys always present (zero
+    included), so dashboards and the chaos soak can diff snapshots
+    without key existence checks."""
     L = lib()
     n = L.eg_counter_count()
     arr = (ctypes.c_uint64 * n)()

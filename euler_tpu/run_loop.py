@@ -75,6 +75,16 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--deadline_ms", type=int, default=None, help=(
         "overall wall-clock budget of ONE graph call spanning all its "
         "retries (default: timeout_ms * (retries+1))"))
+    p.add_argument("--feature_cache_mb", type=int, default=None, help=(
+        "byte budget (MB) of the remote client's dense-feature-row "
+        "cache (remote/shared graph modes; native default 64, 0 "
+        "disables). The graph is immutable after load, so cached rows "
+        "never invalidate"))
+    p.add_argument("--strict", type=_str2bool, default=False, help=(
+        "remote/shared graph modes: raise when a shard call fails after "
+        "all transport retries instead of silently training on "
+        "default-filled rows (failures are counted in rpc_errors either "
+        "way)"))
     p.add_argument("--fault", default="", help=(
         "deterministic transport failpoint spec for chaos drills, e.g. "
         "'recv_frame:err@0.1,dial:delay@50' (remote/shared modes; see "
@@ -207,6 +217,14 @@ def build_graph(args):
             "--fault needs --graph_mode=remote or shared (failpoints sit "
             "in the transport; see FAULTS.md)"
         )
+    if args.graph_mode == "local" and (
+        args.feature_cache_mb is not None or args.strict
+    ):
+        raise ValueError(
+            "--feature_cache_mb/--strict need --graph_mode=remote or "
+            "shared (they configure the remote client's request path; "
+            "a local graph reads its own memory)"
+        )
     if args.graph_mode == "local":
         graph = euler_tpu.Graph(
             directory=args.data_dir, stream=args.stream
@@ -219,6 +237,8 @@ def build_graph(args):
             rediscover_ms=args.rediscover_ms,
             backoff_ms=args.backoff_ms,
             deadline_ms=args.deadline_ms,
+            feature_cache_mb=args.feature_cache_mb,
+            strict=args.strict or None,
             fault=args.fault or None,
             fault_seed=args.fault_seed if args.fault else None,
         )
@@ -346,6 +366,8 @@ def build_graph(args):
             rediscover_ms=args.rediscover_ms,
             backoff_ms=args.backoff_ms,
             deadline_ms=args.deadline_ms,
+            feature_cache_mb=args.feature_cache_mb,
+            strict=args.strict or None,
             fault=args.fault or None,
             fault_seed=args.fault_seed if args.fault else None,
         )

@@ -1,0 +1,324 @@
+#!/usr/bin/env python3
+"""Remote-path benchmark: edges/s + counter ledger over a local 2-shard
+cluster, before/after the hot-path optimizations.
+
+The ROADMAP's scaling story — shard the graph, serve millions of users —
+had no PERF.md row until this script: the single-chip device path is
+measured to death while the remote client was never timed at all. This
+drives the workload the remote client actually serves in training (a
+2-hop fanout + a dense-feature batch over the fanout frontier, the
+model.sample shape) against REAL shard services on localhost, twice:
+
+  baseline   coalesce=0, feature_cache_mb=0 — the pre-PR wire shape
+             (every duplicate id re-sent, every feature row refetched)
+  optimized  defaults — persistent dispatcher + duplicate-id coalescing
+             + client-side feature-row cache
+
+and reports edges/s for both plus the counter ledger
+(ids_deduped / cache_hits / cache_misses / rpc_chunks, FAULTS.md) and
+the ids-on-wire accounting
+(ids_on_wire = ids_requested - ids_deduped - cache_hits).
+
+The graph is synthetic power-law (hub-heavy, the Reddit shape): hubs
+carry most edge mass, so the fanout frontier is dominated by duplicate
+hub ids — exactly the regime the optimizations target. Localhost TCP
+understates the win of cutting wire BYTES (loopback bandwidth is free);
+the dedup win measured here is mostly serialization + server lookup
+work, so treat the edges/s ratio as a floor for real networks.
+
+Usage:
+    python scripts/remote_bench.py             # full run, JSON to stdout
+    python scripts/remote_bench.py --smoke     # small/fast (verify.sh)
+    python bench.py --configs remote           # same, bench-driver shaped
+
+Subprocess shards by default (one OS process per shard, like the chaos
+soak) so server CPU is not attributed to the client loop; --inproc uses
+in-process services (faster startup, used by --smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NUM_SHARDS = 2
+NUM_PARTITIONS = 4
+
+PL_META = {
+    "node_type_num": 2,
+    "edge_type_num": 2,
+    "node_uint64_feature_num": 1,
+    "node_float_feature_num": 1,
+    "node_binary_feature_num": 0,
+    "edge_uint64_feature_num": 0,
+    "edge_float_feature_num": 0,
+    "edge_binary_feature_num": 0,
+}
+
+
+def build_powerlaw_fixture(directory: str, num_nodes: int, avg_degree: int,
+                           feature_dim: int, alpha: float = 1.1,
+                           seed: int = 7) -> None:
+    """Hub-heavy synthetic graph: zipf(alpha)-ranked destination draws, so
+    the first few ids soak up most edge mass (the Reddit heavy tail at
+    bench size)."""
+    import euler_tpu
+
+    rng = np.random.default_rng(seed)
+    # zipf-ish rank weights over destinations
+    ranks = np.arange(1, num_nodes + 1, dtype=np.float64)
+    probs = ranks ** (-alpha)
+    probs /= probs.sum()
+    nodes = []
+    for nid in range(num_nodes):
+        deg = max(1, int(rng.poisson(avg_degree)))
+        dsts = rng.choice(num_nodes, size=deg, p=probs)
+        groups: dict = {}
+        for d in dsts:
+            d = int(d)
+            t = d % 2
+            groups.setdefault(t, {})
+            groups[t][d] = groups[t].get(d, 0.0) + 1.0
+        nodes.append(
+            {
+                "node_id": nid,
+                "node_type": nid % 2,
+                "node_weight": 1.0,
+                "neighbor": {
+                    str(t): {str(d): w for d, w in g.items()}
+                    for t, g in groups.items()
+                },
+                "uint64_feature": {"0": [nid]},
+                "float_feature": {
+                    "0": (np.arange(feature_dim) * 0.01 + nid * 0.001)
+                    .astype(float).tolist()
+                },
+                "binary_feature": {},
+                "edge": [
+                    {
+                        "src_id": nid, "dst_id": d, "edge_type": t,
+                        "weight": w, "uint64_feature": {},
+                        "float_feature": {}, "binary_feature": {},
+                    }
+                    for t, g in groups.items()
+                    for d, w in g.items()
+                ],
+            }
+        )
+    euler_tpu.convert_dicts(
+        nodes, PL_META, os.path.join(directory, "part"),
+        num_partitions=NUM_PARTITIONS,
+    )
+
+
+def _launch_shards_subproc(data: str, reg: str):
+    """One OS process per shard (the chaos-soak launcher shape)."""
+    import socket
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "euler_tpu.graph.service",
+             "--data_dir", data, "--shard_idx", str(s),
+             "--shard_num", str(NUM_SHARDS), "--registry", reg],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+        )
+        for s in range(NUM_SHARDS)
+    ]
+    deadline = time.monotonic() + 90.0
+    for s in range(NUM_SHARDS):
+        while True:
+            entry = next(
+                (f for f in os.listdir(reg) if f.startswith(f"{s}#")), None
+            )
+            if entry is not None:
+                host, port = entry.split("#", 1)[1].rsplit("_", 1)
+                try:
+                    with socket.create_connection((host, int(port)), 1.0):
+                        break
+                except OSError:
+                    pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"shard {s} never registered in {reg}")
+            time.sleep(0.1)
+    return procs
+
+
+def _launch_shards_inproc(data: str, reg: str):
+    from euler_tpu.graph.service import GraphService
+
+    return [
+        GraphService(data, s, NUM_SHARDS, registry=reg)
+        for s in range(NUM_SHARDS)
+    ]
+
+
+def run_workload(graph, steps: int, batch: int, fanouts, feature_dim: int,
+                 seed: int = 5):
+    """The training-shaped remote workload: per step draw roots, run the
+    2-hop fanout, fetch dense features for the full frontier (roots +
+    both hops — what model.sample feeds the encoder). Returns (edges/s,
+    wall s, ids_requested) where ids_requested counts every id a
+    pre-dedup client would put on the wire."""
+    from euler_tpu.graph import native
+
+    f1, f2 = fanouts
+    edges_per_step = batch * (f1 + f1 * f2)
+    native.lib().eg_seed(seed)
+    requested = 0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        roots = graph.sample_node(batch, -1)
+        hop_ids, _, _ = graph.sample_fanout(roots, [[0, 1], [0, 1]], [f1, f2])
+        requested += batch + batch * f1  # fanout hop inputs
+        frontier = np.concatenate(hop_ids)
+        graph.get_dense_feature(frontier, [0], [feature_dim])
+        requested += len(frontier)
+    dt = time.perf_counter() - t0
+    return edges_per_step * steps / dt, dt, requested
+
+
+def bench_config(reg: str, steps: int, batch: int, fanouts,
+                 feature_dim: int, label: str, **graph_kwargs):
+    """One measured client configuration against the running cluster:
+    returns {edges_per_sec, wall_s, ids_requested, ids_on_wire,
+    counters} for `steps` workload iterations (after one untimed warmup
+    step that pays dial/compile costs)."""
+    import euler_tpu
+    from euler_tpu.graph import native
+
+    g = euler_tpu.Graph(mode="remote", registry=reg, **graph_kwargs)
+    try:
+        run_workload(g, 1, batch, fanouts, feature_dim)  # warm dials/cache
+        native.counters_reset()
+        eps, dt, requested = run_workload(g, steps, batch, fanouts,
+                                          feature_dim)
+        ctr = native.counters()
+    finally:
+        g.close()
+    on_wire = requested - ctr["ids_deduped"] - ctr["cache_hits"]
+    return {
+        "label": label,
+        "edges_per_sec": round(eps, 1),
+        "wall_s": round(dt, 3),
+        "ids_requested": requested,
+        "ids_on_wire": on_wire,
+        "counters": {k: v for k, v in ctr.items() if v},
+    }
+
+
+def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
+                     steps: int | None = None) -> dict:
+    """Full before/after measurement; returns the bench-driver-shaped
+    result dict (metric/value/unit/vs_baseline/detail)."""
+    import shutil
+    import tempfile
+
+    if smoke:
+        num_nodes, avg_degree, feature_dim = 300, 10, 16
+        batch, fanouts = 32, (5, 5)
+        steps = steps or 4
+        if inproc is None:
+            inproc = True
+    else:
+        num_nodes, avg_degree, feature_dim = 20000, 30, 64
+        batch, fanouts = 512, (10, 10)
+        steps = steps or 20
+        if inproc is None:
+            inproc = False
+
+    tmp = tempfile.mkdtemp(prefix="euler_remote_bench_")
+    data = os.path.join(tmp, "data")
+    reg = os.path.join(tmp, "reg")
+    os.makedirs(data)
+    os.makedirs(reg)
+    procs = []
+    try:
+        build_powerlaw_fixture(data, num_nodes, avg_degree, feature_dim)
+        procs = (_launch_shards_inproc if inproc else
+                 _launch_shards_subproc)(data, reg)
+
+        # BASELINE: the pre-PR wire shape (dedup + cache off; the
+        # dispatcher still runs — thread spawn/join cannot be re-added)
+        before = bench_config(
+            reg, steps, batch, fanouts, feature_dim, "baseline",
+            coalesce=False, feature_cache_mb=0,
+        )
+        # OPTIMIZED: defaults (coalesce on, cache on)
+        after = bench_config(
+            reg, steps, batch, fanouts, feature_dim, "optimized",
+        )
+        reduction = (
+            after["ids_requested"] / after["ids_on_wire"]
+            if after["ids_on_wire"] > 0 else float("inf")
+        )
+        value = after["edges_per_sec"]
+        return {
+            "metric": "remote_edges/sec",
+            "value": value,
+            "unit": "edges/s",
+            "vs_baseline": round(value / 2_000_000.0, 3),
+            "detail": {
+                "config": "remote",
+                "cluster": f"{NUM_SHARDS} shards, localhost, "
+                           f"{'in-process' if inproc else 'subprocess'}",
+                "graph": {
+                    "num_nodes": num_nodes, "avg_degree": avg_degree,
+                    "feature_dim": feature_dim, "powerlaw_alpha": 1.1,
+                },
+                "workload": {
+                    "batch": batch, "fanouts": list(fanouts),
+                    "steps": steps,
+                },
+                "before": before,
+                "after": after,
+                "speedup": round(
+                    after["edges_per_sec"] / before["edges_per_sec"], 3
+                ),
+                "ids_on_wire_reduction": round(reduction, 2),
+            },
+        }
+    finally:
+        for p in procs:
+            if hasattr(p, "stop"):
+                p.stop()
+            elif p.poll() is None:
+                p.kill()
+                p.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="small graph, few steps, in-process shards "
+                    "(the verify.sh gate)")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--inproc", action="store_true", default=None,
+                    help="in-process shard services instead of "
+                    "subprocesses")
+    args = ap.parse_args()
+    result = run_remote_bench(smoke=args.smoke, inproc=args.inproc,
+                              steps=args.steps)
+    print(json.dumps(result), flush=True)
+    detail = result["detail"]
+    if args.smoke:
+        # the smoke gate's contract: the optimized path must demonstrably
+        # coalesce — a silent dedup regression fails verify, not PERF.md
+        assert detail["ids_on_wire_reduction"] >= 2.0, detail
+        assert detail["after"]["counters"].get("ids_deduped", 0) > 0, detail
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

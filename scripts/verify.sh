@@ -32,6 +32,13 @@ else
   echo "SKIPPED: mypy not installed in this image (config: pyproject.toml [tool.mypy])"
 fi
 
+step "remote-bench smoke (scripts/remote_bench.py --smoke)"
+# End-to-end remote hot path against a real in-process 2-shard cluster:
+# asserts the dedup/cache ledger shows a real ids-on-wire reduction, so
+# a silent coalescing regression fails verify before it reaches PERF.md.
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/remote_bench.py --smoke >/dev/null || fail=1
+
 step "chaos soak + failpoint counters (FAULTS.md)"
 # Runs the fault-injection suites by name so a transport regression
 # fails fast with a targeted log, before the full tier-1 sweep below

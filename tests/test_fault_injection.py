@@ -25,6 +25,9 @@ COUNTER_NAMES = {
     "dials_failed", "retries", "quarantines", "failovers", "calls_failed",
     "deadlines_exceeded", "frames_rejected", "rediscoveries",
     "heartbeat_misses",
+    # remote hot-path efficiency ledger (PR 3): dedup/cache/chunking
+    # wins plus op-level shard failures
+    "ids_deduped", "cache_hits", "cache_misses", "rpc_chunks", "rpc_errors",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
@@ -307,6 +310,55 @@ def test_registry_reply_fault_fails_one_list(tmp_path):
     finally:
         native.fault_clear()
         reg.stop()
+
+
+def test_dispatcher_chunked_call_retries_through_faults(shard):
+    """The persistent-dispatcher + chunked path must keep every transport
+    guarantee of the old per-call-thread path: a chunk whose send fails
+    retries through a redial, the counters account for it exactly, and
+    the merged result is still correct."""
+    svc, reg = shard
+    # chunk_ids=2 forces the 6-unique-id request below into 3 chunks on
+    # the single shard; cache off so the second call re-issues them
+    g = Graph(mode="remote", registry=reg, retries=3, timeout_ms=2000,
+              backoff_ms=1, chunk_ids=2, feature_cache_mb=0)
+    try:
+        ids = np.array([10, 11, 12, 13, 14, 15], dtype=np.int64)
+        g.node_types(ids)  # warm pooled connections, pre-fault
+        native.fault_config("send_frame:err@1.0#1", 7)
+        native.counters_reset()
+        t = g.node_types(ids)
+        np.testing.assert_array_equal(t, [0, 1, 0, 1, 0, 1])
+        assert native.fault_injected()["send_frame"] == 1
+        ctr = native.counters()
+        assert ctr["rpc_chunks"] == 3, ctr      # ceil(6 / 2) chunks issued
+        assert ctr["retries"] == 1, ctr         # exactly the faulted chunk
+        assert ctr["failovers"] == 1, ctr
+        assert ctr["rpc_errors"] == 0, ctr      # the retry succeeded
+    finally:
+        g.close()
+
+
+def test_rpc_errors_counts_exhausted_shard_call(shard):
+    """When every retry of a chunk fails, the op-level failure (rows
+    degraded to defaults) must be visible in rpc_errors — the counter
+    the old ForShards bool-discard made impossible to observe."""
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=1, timeout_ms=2000,
+              backoff_ms=1, deadline_ms=300)
+    try:
+        one = np.array([10], dtype=np.int64)
+        g.node_types(one)  # warm up pre-fault
+        native.fault_config("send_frame:err@1.0", 13)  # every send fails
+        native.counters_reset()
+        t = g.node_types(one)
+        assert t[0] == -1  # degraded to default, not wedged
+        ctr = native.counters()
+        assert ctr["rpc_errors"] == 1, ctr
+        assert ctr["calls_failed"] == 1, ctr
+    finally:
+        native.fault_clear()
+        g.close()
 
 
 # ---------------------------------------------------------------------------

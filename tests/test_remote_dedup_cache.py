@@ -1,0 +1,418 @@
+"""Remote hot path: duplicate-id coalescing, the client-side feature-row
+cache, request chunking, and strict shard-failure surfacing.
+
+The seed motivation (ISSUE 3): on power-law graphs hubs carry most edge
+mass, so a fanout batch repeats the same ids thousands of times and the
+pre-PR client re-sent every duplicate per hop and refetched hot feature
+rows endlessly. These tests pin, against a REAL 2-shard local cluster on
+a hub-heavy fixture:
+
+  * parity — every dedup'd/cached op returns exactly what the embedded
+    host engine returns (deterministic ops), and the dedup'd sampler
+    matches the host engine's neighbor distribution while keeping
+    duplicate rows independent (the kSampleNeighborUniq reps contract);
+  * exact counter arithmetic for ids_deduped / cache_hits /
+    cache_misses / rpc_chunks;
+  * the ISSUE's acceptance criterion: a 2-hop fanout + feature batch on
+    the power-law fixture cuts ids-on-wire by >= 5x, verified from the
+    counter ledger;
+  * strict= raises through the C ABI when a shard is unreachable, while
+    the default path degrades to defaults and counts rpc_errors.
+"""
+
+import numpy as np
+import pytest
+
+import euler_tpu
+from euler_tpu.graph import native
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.service import GraphService
+
+NUM_SHARDS = 2
+NUM_PARTITIONS = 4
+NUM_NODES = 60
+HUBS = 6  # low ids get the overwhelming share of in-edges
+
+PL_META = {
+    "node_type_num": 2,
+    "edge_type_num": 2,
+    "node_uint64_feature_num": 1,
+    "node_float_feature_num": 2,
+    "node_binary_feature_num": 1,
+    "edge_uint64_feature_num": 1,
+    "edge_float_feature_num": 1,
+    "edge_binary_feature_num": 1,
+}
+
+
+def powerlaw_nodes():
+    """Hub-heavy deterministic graph: every node's out-edges point mostly
+    at the first HUBS ids (zipf-ish), so any fanout batch is dominated by
+    duplicate hub ids — the Reddit-scale shape at fixture size."""
+    rng = np.random.default_rng(7)
+    nodes = []
+    for nid in range(NUM_NODES):
+        deg = 3 + int(rng.integers(0, 4))
+        # ~80% of edge mass onto hubs, the rest uniform
+        dsts = []
+        for _ in range(deg):
+            if rng.random() < 0.8:
+                dsts.append(int(rng.integers(0, HUBS)))
+            else:
+                dsts.append(int(rng.integers(0, NUM_NODES)))
+        groups: dict = {}
+        for d in dsts:
+            t = d % 2
+            groups.setdefault(t, {})[d] = groups.get(t, {}).get(d, 0.0) + 1.0
+        edges = [
+            {
+                "src_id": nid, "dst_id": d, "edge_type": t, "weight": w,
+                "uint64_feature": {"0": [nid * 1000 + d]},
+                "float_feature": {"0": [w * 0.5]},
+                "binary_feature": {"0": "e%d-%d" % (nid, d)},
+            }
+            for t, g in groups.items()
+            for d, w in g.items()
+        ]
+        nodes.append(
+            {
+                "node_id": nid,
+                "node_type": nid % 2,
+                "node_weight": 1.0 + (nid % 5),
+                "neighbor": {
+                    str(t): {str(d): w for d, w in g.items()}
+                    for t, g in groups.items()
+                },
+                "uint64_feature": {"0": [nid, nid + 1]},
+                "float_feature": {
+                    "0": [nid * 0.5, nid * 0.25, float(nid % 3)],
+                    "1": [1.0 + nid],
+                },
+                "binary_feature": {"0": "n%d" % nid},
+                "edge": edges,
+            }
+        )
+    return nodes
+
+
+@pytest.fixture(scope="module")
+def pl_cluster(tmp_path_factory):
+    """(local graph, registry dir, services, data dir) over the
+    power-law fixture."""
+    data = str(tmp_path_factory.mktemp("pl_data"))
+    euler_tpu.convert_dicts(
+        powerlaw_nodes(), PL_META, data + "/part",
+        num_partitions=NUM_PARTITIONS,
+    )
+    reg = str(tmp_path_factory.mktemp("pl_reg"))
+    services = [
+        GraphService(data, s, NUM_SHARDS, registry=reg)
+        for s in range(NUM_SHARDS)
+    ]
+    local = Graph(directory=data)
+    yield local, reg, services, data
+    for s in services:
+        s.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    native.counters_reset()
+    yield
+    native.counters_reset()
+
+
+def hub_heavy_ids(n=600, seed=3):
+    """An id batch shaped like a fanout result: mostly duplicate hubs."""
+    rng = np.random.default_rng(seed)
+    ids = np.where(
+        rng.random(n) < 0.8,
+        rng.integers(0, HUBS, n),
+        rng.integers(0, NUM_NODES, n),
+    )
+    return ids.astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# parity: dedup + cache + chunking return exactly the host engine's answers
+# ---------------------------------------------------------------------------
+
+
+def test_deterministic_ops_parity_with_duplicates(pl_cluster):
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg, chunk_ids=7)
+    try:
+        ids = hub_heavy_ids()
+        for _ in range(2):  # second pass serves dense rows from the cache
+            np.testing.assert_array_equal(
+                remote.node_types(ids), local.node_types(ids)
+            )
+            np.testing.assert_allclose(
+                remote.get_dense_feature(ids, [0, 1], [3, 1]),
+                local.get_dense_feature(ids, [0, 1], [3, 1]),
+            )
+            np.testing.assert_allclose(
+                remote.node_weights(ids), local.node_weights(ids)
+            )
+            l = local.get_full_neighbor(ids, [0, 1])
+            r = remote.get_full_neighbor(ids, [0, 1])
+            for a, b in zip(l, r):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            lt = local.get_top_k_neighbor(ids, [0, 1], 3)
+            rt = remote.get_top_k_neighbor(ids, [0, 1], 3)
+            for a, b in zip(lt, rt):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            ls = local.get_sparse_feature(ids, [0])
+            rs = remote.get_sparse_feature(ids, [0])
+            for (lv, lc), (rv, rc) in zip(ls, rs):
+                np.testing.assert_array_equal(lv, rv)
+                np.testing.assert_array_equal(lc, rc)
+            lb = local.get_binary_feature(ids, [0])
+            rb = remote.get_binary_feature(ids, [0])
+            assert lb == rb
+    finally:
+        remote.close()
+
+
+def test_sample_neighbor_dedup_distribution_and_independence(pl_cluster):
+    """The kSampleNeighborUniq contract: a hub id repeated many times
+    gets draws matching the host engine's neighbor distribution AND the
+    duplicate rows stay independent (each row is a fresh reps-block, not
+    a copy of one shared sample)."""
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg)
+    try:
+        hub = 0
+        reps = 300
+        ids = np.full(reps, hub, dtype=np.int64)
+        count = 8
+        native.lib().eg_seed(11)
+        r_nbr, _, _ = remote.sample_neighbor(ids, [0, 1], count)
+        r_nbr = np.asarray(r_nbr)
+        # duplicates are NOT identical copies: with >= 2 distinct
+        # neighbors, 300 iid 8-draw rows collide completely only with
+        # vanishing probability
+        distinct_rows = {tuple(row) for row in r_nbr.tolist()}
+        assert len(distinct_rows) > 1, "duplicate rows shared one sample"
+        # empirical marginal matches the host engine's distribution
+        native.lib().eg_seed(11)
+        l_nbr, _, _ = local.sample_neighbor(ids, [0, 1], count)
+        l_nbr = np.asarray(l_nbr)
+        values = np.unique(np.concatenate([r_nbr.ravel(), l_nbr.ravel()]))
+        for v in values:
+            rf = (r_nbr == v).mean()
+            lf = (l_nbr == v).mean()
+            assert abs(rf - lf) < 0.05, (v, rf, lf)
+    finally:
+        remote.close()
+
+
+# ---------------------------------------------------------------------------
+# exact counter arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_dedup_and_cache_counter_arithmetic(pl_cluster):
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg)
+    try:
+        ids = np.array([0, 1, 0, 2, 1, 0, 3, 0], dtype=np.int64)
+        uniq = len(set(ids.tolist()))  # 4
+        dups = len(ids) - uniq         # 4
+        native.counters_reset()
+        remote.get_dense_feature(ids, [0], [3])
+        c = native.counters()
+        assert c["ids_deduped"] == dups, c
+        assert c["cache_misses"] == uniq, c  # cold cache: every unique fetched
+        assert c["cache_hits"] == 0, c
+        remote.get_dense_feature(ids, [0], [3])  # identical call: all cached
+        c = native.counters()
+        assert c["cache_hits"] == uniq, c
+        assert c["cache_misses"] == uniq, c      # unchanged
+        assert c["ids_deduped"] == 2 * dups, c
+        # node_types dedups too (no cache: types ride the wire each call)
+        native.counters_reset()
+        remote.node_types(ids)
+        c = native.counters()
+        assert c["ids_deduped"] == dups, c
+        assert c["cache_hits"] == 0 and c["cache_misses"] == 0, c
+    finally:
+        remote.close()
+
+
+def test_cache_disabled_and_coalesce_disabled(pl_cluster):
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg, feature_cache_mb=0,
+                   coalesce=False)
+    try:
+        ids = hub_heavy_ids(200)
+        native.counters_reset()
+        for _ in range(2):
+            np.testing.assert_allclose(
+                remote.get_dense_feature(ids, [0], [3]),
+                local.get_dense_feature(ids, [0], [3]),
+            )
+        c = native.counters()
+        # the pre-PR wire shape: nothing deduped, nothing cached
+        assert c["ids_deduped"] == 0, c
+        assert c["cache_hits"] == 0 and c["cache_misses"] == 0, c
+    finally:
+        remote.close()
+
+
+def test_chunking_arithmetic_and_parity(pl_cluster):
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg, chunk_ids=8,
+                   feature_cache_mb=0)
+    try:
+        ids = np.arange(NUM_NODES, dtype=np.int64)  # all unique
+        native.counters_reset()
+        np.testing.assert_array_equal(
+            remote.node_types(ids), local.node_types(ids)
+        )
+        c = native.counters()
+        # every id unique: per-shard unique counts are the shard row
+        # counts; each shard's request splits into ceil(m/8) chunks
+        per_shard = [0] * NUM_SHARDS
+        for i in ids:
+            per_shard[(int(i) % NUM_PARTITIONS) % NUM_SHARDS] += 1
+        want = sum(-(-m // 8) for m in per_shard if m > 8)
+        assert c["rpc_chunks"] == want, (c, per_shard)
+    finally:
+        remote.close()
+
+
+def test_cache_stays_capacity_bounded(pl_cluster):
+    """A 1 MB budget cannot hold 20 specs x 60 rows x ~2 KB: insertions
+    must evict (oldest rows miss again on re-request) instead of
+    growing without bound."""
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg, feature_cache_mb=1)
+    try:
+        ids = np.arange(NUM_NODES, dtype=np.int64)
+        # dims are request-side: the engine zero-pads short rows, so a
+        # 512-float request makes each cached row ~2 KB; each rep is a
+        # distinct (fids, dims) spec, i.e. a distinct cache key set
+        for rep in range(20):
+            remote.get_dense_feature(ids, [0], [512 + rep])
+        native.counters_reset()
+        # the first spec's rows are the oldest everywhere: a bounded FIFO
+        # must have evicted (essentially) all of them by now
+        remote.get_dense_feature(ids, [0], [512])
+        c = native.counters()
+        assert c["cache_misses"] >= NUM_NODES * 0.5, c
+        # and correctness never degraded while evicting
+        np.testing.assert_allclose(
+            remote.get_dense_feature(ids, [0], [3]),
+            local.get_dense_feature(ids, [0], [3]),
+        )
+    finally:
+        remote.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: >= 5x ids-on-wire reduction, counter-verified
+# ---------------------------------------------------------------------------
+
+
+def test_fanout_feature_batch_cuts_ids_on_wire_5x(pl_cluster):
+    """ISSUE 3 acceptance: on the power-law fixture, a 2-hop fanout +
+    feature batch shows ids_deduped/cache_hits accounting for a >= 5x
+    reduction in ids-on-wire vs the pre-PR ledger (which sent every id)."""
+    local, reg, _, _ = pl_cluster
+    remote = Graph(mode="remote", registry=reg)
+    try:
+        batch, f1, f2 = 64, 10, 10
+        steps = 8
+        requested = 0
+        native.counters_reset()
+        for step in range(steps):
+            roots = np.asarray(local.sample_node(batch, -1))
+            hop_ids, _, _ = remote.sample_fanout(
+                roots, [[0, 1], [0, 1]], [f1, f2]
+            )
+            # ids put on the wire pre-PR: every hop input id...
+            requested += batch + batch * f1
+            feats = remote.get_dense_feature(hop_ids[2], [0], [3])
+            # ...plus every feature row id
+            requested += batch * f1 * f2
+            assert feats.shape == (batch * f1 * f2, 3)
+        c = native.counters()
+        sent = requested - c["ids_deduped"] - c["cache_hits"]
+        assert sent > 0
+        reduction = requested / sent
+        assert reduction >= 5.0, (
+            f"ids-on-wire reduction {reduction:.2f}x < 5x "
+            f"(requested={requested}, sent={sent}, ledger={c})"
+        )
+    finally:
+        remote.close()
+
+
+# ---------------------------------------------------------------------------
+# strict= surfaces shard failure; default degrades + counts
+# ---------------------------------------------------------------------------
+
+
+def test_strict_raises_on_dead_shard_and_recovers(pl_cluster):
+    """strict=1: a shard that dies after init must surface as an error
+    (through the C ABI side channel) instead of silently yielding
+    default rows — and the pending error is consumed, so the next
+    healthy call proceeds."""
+    local, reg, services, data = pl_cluster
+    # a private shard-1 service: killing it must not disturb the shared
+    # module cluster (Init needs every shard up, so it starts alive)
+    svc1 = GraphService(data, 1, NUM_SHARDS)
+    g = Graph(
+        mode="remote", shards=[[services[0].address], [svc1.address]],
+        retries=0, timeout_ms=500, strict=True,
+    )
+    try:
+        bad_ids = np.array(
+            [i for i in range(NUM_NODES)
+             if (i % NUM_PARTITIONS) % NUM_SHARDS == 1],
+            dtype=np.int64,
+        )
+        np.testing.assert_array_equal(  # healthy: strict stays silent
+            g.node_types(bad_ids), local.node_types(bad_ids)
+        )
+        svc1.stop()
+        native.counters_reset()
+        with pytest.raises(RuntimeError, match="shard 1"):
+            g.node_types(bad_ids)
+        assert native.counters()["rpc_errors"] >= 1
+        # the pending error is consumed: a following healthy call works
+        good = np.array([0], dtype=np.int64)
+        assert (int(good[0]) % NUM_PARTITIONS) % NUM_SHARDS == 0
+        np.testing.assert_array_equal(
+            g.node_types(good), local.node_types(good)
+        )
+    finally:
+        g.close()
+        svc1.stop()
+
+
+def test_default_mode_degrades_but_counts_rpc_errors(pl_cluster):
+    local, reg, services, data = pl_cluster
+    svc1 = GraphService(data, 1, NUM_SHARDS)
+    g = Graph(
+        mode="remote", shards=[[services[0].address], [svc1.address]],
+        retries=0, timeout_ms=500,
+    )
+    try:
+        svc1.stop()
+        bad = np.array([1], dtype=np.int64)  # (1 % 4) % 2 == 1 -> shard 1
+        native.counters_reset()
+        t = g.node_types(bad)
+        assert t[0] == -1  # silent default (the pre-strict contract)
+        assert native.counters()["rpc_errors"] >= 1
+    finally:
+        g.close()
+        svc1.stop()
+
+
+def test_strict_rejected_on_local_mode(tmp_path):
+    with pytest.raises(ValueError, match="remote"):
+        Graph(directory=str(tmp_path), strict=True)
+    with pytest.raises(ValueError, match="remote"):
+        Graph(directory=str(tmp_path), feature_cache_mb=32)

@@ -42,10 +42,15 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 20, files  # all .cc and .h of _native
-    # the fault layer must be under the gate, not grandfathered around it
+    assert len(files) >= 24, files  # all .cc and .h of _native
+    # the fault layer and the remote hot-path additions (persistent
+    # dispatcher + feature cache) must be under the gate, not
+    # grandfathered around it
     names = {pathlib.Path(f).name for f in files}
-    assert {"eg_fault.cc", "eg_fault.h"} <= names, names
+    assert {
+        "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
+        "eg_cache.cc", "eg_cache.h",
+    } <= names, names
     violations = []
     for f in files:
         violations.extend(check_native.lint_file(f))
