@@ -33,6 +33,7 @@ from euler_tpu.graph.native import (
     fault_clear,
     fault_config,
     fault_injected,
+    reset_counters,
     stats,
     stats_reset,
 )
@@ -42,6 +43,6 @@ __version__ = "0.2.0"
 
 __all__ = [
     "Graph", "GraphService", "convert", "convert_dicts", "stats",
-    "stats_reset", "counters", "counters_reset", "fault_config",
-    "fault_clear", "fault_injected",
+    "stats_reset", "counters", "counters_reset", "reset_counters",
+    "fault_config", "fault_clear", "fault_injected",
 ]

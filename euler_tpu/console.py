@@ -267,9 +267,13 @@ class Console:
             for name, s in sorted(snap.items()):
                 print(f"{name:16s} {s['count']:10d} {s['total_ms']:10.2f} "
                       f"{s['avg_us']:10.2f} {s['max_us']:10.2f}")
+        # one ledger, both sides: client transport fight (retries,
+        # failovers, ...) and — when this process serves a shard —
+        # server survivability (busy_rejects, handler_timeouts,
+        # deadline_rejects, draining), all via the eg_counters_* ABI
         fails = {k: v for k, v in counters().items() if v}
         if fails:
-            print("failures:")
+            print("counters:")
             for name, v in sorted(fails.items()):
                 print(f"  {name:20s} {v:10d}")
 

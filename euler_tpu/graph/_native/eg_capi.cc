@@ -190,12 +190,17 @@ int eg_remote_strict_error(void* h, char* buf, int cap) {
 
 // ---- graph service (StartService equivalent,
 // reference euler/service/python_api.cc:26-52) ----
+// `options` is the "k=v;k=v" admission spec (workers/pending/max_conns/
+// io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version — see
+// eg_admission.h); NULL/empty = defaults. Unknown keys fail loudly.
 void* eg_service_start(const char* data_dir, int shard_idx, int shard_num,
-                       const char* host, int port, const char* registry_dir) {
+                       const char* host, int port, const char* registry_dir,
+                       const char* options) {
   try {
     auto s = std::make_unique<Service>();
     if (!s->Start(data_dir, shard_idx, shard_num, host ? host : "",
-                  port, registry_dir ? registry_dir : "")) {
+                  port, registry_dir ? registry_dir : "",
+                  options ? options : "")) {
       g_last_error = s->error();
       return nullptr;
     }
@@ -209,6 +214,18 @@ int eg_service_port(void* s) {
     return static_cast<Service*>(s)->port();
   }
   EG_API_GUARD(-1)
+}
+
+// Drain-before-stop (the SIGTERM half of a rolling restart, DEPLOY.md):
+// deregister from discovery, stop accepting, let in-flight requests
+// finish (up to grace_ms; <=0 = the service's drain_ms option), close
+// every connection. The handle stays valid; call eg_service_stop to
+// free it.
+void eg_service_drain(void* s, int grace_ms) {
+  try {
+    static_cast<Service*>(s)->Drain(grace_ms > 0 ? grace_ms : -1);
+  }
+  EG_API_GUARD()
 }
 
 void eg_service_stop(void* s) {

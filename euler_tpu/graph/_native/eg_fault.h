@@ -35,9 +35,17 @@ enum FaultId : int {
   kFaultDial = 0,      // DialTcp: connect fails (-1) or is delayed
   kFaultSendFrame,     // SendFrame: write fails (connection is discarded)
   kFaultRecvFrame,     // RecvFrame: read fails (mid-frame reset analog)
-  kFaultServiceReply,  // Service::HandleConn: reply dropped, conn closed
+  kFaultServiceReply,  // service worker: reply dropped, conn closed
   kFaultRegistryReply, // RegistryServer::HandleConn: ditto for LIST/REG
   kFaultHeartbeat,     // Service heartbeat: one beat forced to miss
+  // Server-side survivability failpoints (eg_admission.cc):
+  kFaultAccept,        // admission: the accepted connection is dropped
+                       // on the floor (err) or accept is slowed (delay)
+  kFaultHandlerStall,  // worker, post-recv pre-dispatch: the handler
+                       // stalls (delay — drives deadline replies) or
+                       // wedges and abandons the connection (err)
+  kFaultBusyForce,     // admission: the capacity check is forced to
+                       // report overload — a deterministic BUSY reply
   kFaultIdCount,
 };
 
@@ -45,6 +53,7 @@ enum FaultId : int {
 const char* const kFaultNames[kFaultIdCount] = {
     "dial",           "send_frame", "recv_frame",
     "service_reply",  "registry_reply", "heartbeat",
+    "accept",         "handler_stall",  "busy_force",
 };
 
 class FaultInjector {

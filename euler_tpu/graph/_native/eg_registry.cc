@@ -11,6 +11,7 @@
 #include <sstream>
 
 #include "eg_fault.h"
+#include "eg_stats.h"
 #include "eg_wire.h"
 
 namespace eg {
@@ -42,12 +43,14 @@ void RegistryServer::Stop() {
   ::close(listen_fd_);
   if (accept_thread_.joinable()) accept_thread_.join();
   listen_fd_ = -1;
-  {
-    std::lock_guard<std::mutex> l(mu_);
-    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
-  }
-  while (active_conns_.load(std::memory_order_acquire) > 0)
-    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  // Same drain contract as the shard service: shut the live connections
+  // down, then wait on the condvar (not a busy poll) until every
+  // detached handler has deregistered itself.
+  std::unique_lock<std::mutex> l(mu_);
+  for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  conns_cv_.wait(l, [this] {
+    return active_conns_.load(std::memory_order_acquire) == 0;
+  });
 }
 
 void RegistryServer::AcceptLoop() {
@@ -59,6 +62,16 @@ void RegistryServer::AcceptLoop() {
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Bounded accept (the service's admission treatment, sized for a
+    // control plane): a connection storm gets one "ERR busy" frame and
+    // a close instead of an unbounded handler-thread spawn.
+    if (active_conns_.load(std::memory_order_acquire) >=
+        kMaxRegistryConns) {
+      Counters::Global().Add(kCtrBusyReject);
+      SendFrame(fd, "ERR busy");
+      ::close(fd);
+      continue;
+    }
     {
       std::lock_guard<std::mutex> l(mu_);
       conn_fds_.insert(fd);
@@ -77,6 +90,11 @@ void RegistryServer::AcceptLoop() {
       }
       ::close(fd);
       active_conns_.fetch_sub(1, std::memory_order_acq_rel);
+      {
+        // under mu_, so Stop()'s wait cannot miss the last decrement
+        std::lock_guard<std::mutex> l(mu_);
+        conns_cv_.notify_all();
+      }
     }).detach();
   }
 }

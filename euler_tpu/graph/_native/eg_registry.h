@@ -23,6 +23,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <map>
 #include <mutex>
 #include <set>
@@ -32,6 +33,11 @@
 #include <vector>
 
 namespace eg {
+
+// Registry connections beyond this answer "ERR busy" and close (counted
+// in busy_rejects) — the registry is tiny control-plane traffic, so a
+// storm of connections here is a bug or an attack, not load to queue.
+constexpr int kMaxRegistryConns = 256;
 
 class RegistryServer {
  public:
@@ -63,6 +69,9 @@ class RegistryServer {
       entries_;
   std::set<int> conn_fds_;
   std::atomic<int> active_conns_{0};
+  // signaled (under mu_) as each handler exits, so Stop() can wait on a
+  // condvar instead of the old 1 ms busy-wait poll
+  std::condition_variable conns_cv_;
 };
 
 // ---- client side ----

@@ -80,6 +80,18 @@ bool ReadResult(WireReader* r, EGResult* out) {
   return false;
 }
 
+// The stock error a pre-envelope (wire v1) server answers when it reads
+// the v2 envelope marker as an op code — the downgrade-negotiation
+// signal (see eg_wire.h). Matched exactly: a v2 server's genuine
+// unknown-op errors name ops in the real op range, never the marker.
+bool IsLegacyUnknownOpReply(const std::string& reply) {
+  WireReader r(reply);
+  if (r.U8() != kStatusError) return false;
+  std::string msg = r.Str();
+  return r.ok() && r.remaining() == 0 &&
+         msg == "unknown op " + std::to_string(kWireEnvelope);
+}
+
 }  // namespace
 
 // ---------------- ConnPool ----------------
@@ -130,7 +142,10 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
                     int timeout_ms, int quarantine_ms, int backoff_ms,
                     int deadline_ms) const {
   // snapshot: Update() may swap the set mid-call; shared_ptrs keep every
-  // replica this exchange touches alive
+  // replica this exchange touches alive. Refreshed at every attempt
+  // (below) so a call already mid-retry against a restarted shard picks
+  // up the re-discovered address instead of burning its whole budget on
+  // the dead one — the rolling-restart drill's zero-failed-calls bar.
   std::vector<std::shared_ptr<Replica>> reps;
   {
     std::lock_guard<std::mutex> l(mu_);
@@ -145,6 +160,7 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
                      ? deadline_ms
                      : static_cast<int64_t>(timeout_ms) * (retries + 1));
   bool failed_before = false;
+  int busy_streak = 0;
   for (int attempt = 0; attempt <= retries; ++attempt) {
     // Re-sample the clock each attempt: a slow earlier attempt must age
     // quarantine verdicts and count against the deadline (the old single
@@ -172,45 +188,123 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
         ctr.Add(kCtrDeadlineExceeded);
         break;
       }
-    }
-    // Round-robin replica choice skipping quarantined hosts; if every host
-    // is quarantined, use the nominal one anyway (matches the reference's
-    // bad-host re-admission behavior, rpc_manager.cc:64).
-    size_t start = rr_.fetch_add(1) % reps.size();
-    Replica* rep = reps[start].get();
-    for (size_t k = 0; k < reps.size(); ++k) {
-      Replica* cand = reps[(start + k) % reps.size()].get();
-      if (cand->bad_until_ms.load(std::memory_order_relaxed) <= now) {
-        rep = cand;
-        break;
+      // re-snapshot: the background re-discovery may have learned a
+      // restarted replica's new address while this call backed off
+      {
+        std::lock_guard<std::mutex> l(mu_);
+        if (!replicas_.empty()) reps = replicas_;
       }
     }
-    int fd = -1;
-    {
-      std::lock_guard<std::mutex> l(rep->mu);
-      if (!rep->idle.empty()) {
-        fd = rep->idle.back();
-        rep->idle.pop_back();
+    // One attempt may loop through several BUSY failovers: a shedding
+    // server ANSWERED (it is alive, just refusing new work), so BUSY
+    // burns neither a retry nor backoff nor a quarantine — only the
+    // overall deadline bounds a fully busy replica set.
+    for (;;) {
+      // Round-robin replica choice skipping quarantined hosts; if every
+      // host is quarantined, use the nominal one anyway (matches the
+      // reference's bad-host re-admission behavior, rpc_manager.cc:64).
+      size_t start = rr_.fetch_add(1) % reps.size();
+      Replica* rep = reps[start].get();
+      for (size_t k = 0; k < reps.size(); ++k) {
+        Replica* cand = reps[(start + k) % reps.size()].get();
+        if (cand->bad_until_ms.load(std::memory_order_relaxed) <= now) {
+          rep = cand;
+          break;
+        }
       }
-    }
-    if (fd < 0) fd = DialTcp(rep->host, rep->port, timeout_ms);
-    if (fd < 0) {
-      ctr.Add(kCtrDialFail);
+      int fd = -1;
+      {
+        std::lock_guard<std::mutex> l(rep->mu);
+        if (!rep->idle.empty()) {
+          fd = rep->idle.back();
+          rep->idle.pop_back();
+        }
+      }
+      if (fd < 0) fd = DialTcp(rep->host, rep->port, timeout_ms);
+      if (fd < 0) {
+        ctr.Add(kCtrDialFail);
+        ctr.Add(kCtrQuarantine);
+        rep->bad_until_ms.store(now + quarantine_ms,
+                                std::memory_order_relaxed);
+        failed_before = true;
+        break;  // next attempt (through the backoff above)
+      }
+      // Wire v2: stamp the call's REMAINING budget into the envelope so
+      // the server can refuse work nobody will read. Replicas that
+      // negotiated down (old servers) get the raw v1 request.
+      int ver = forced_version_
+                    ? forced_version_
+                    : rep->wire_version.load(std::memory_order_relaxed);
+      bool sent_envelope = ver != 1;
+      bool io_ok;
+      if (sent_envelope) {
+        int64_t remaining = deadline - NowMs();
+        if (remaining < 0) remaining = 0;
+        io_ok = SendFrame(fd, WrapEnvelope(req, remaining)) &&
+                RecvFrame(fd, reply);
+      } else {
+        io_ok = SendFrame(fd, req) && RecvFrame(fd, reply);
+      }
+      if (io_ok && sent_envelope && ver == 0) {
+        // First exchange against this replica: learn its wire version.
+        if (IsLegacyUnknownOpReply(*reply)) {
+          rep->wire_version.store(1, std::memory_order_relaxed);
+          ctr.Add(kCtrWireDowngrade);
+          // the old server answered its stock error and kept the
+          // connection healthy: resend the raw request on it
+          io_ok = SendFrame(fd, req) && RecvFrame(fd, reply);
+        } else {
+          rep->wire_version.store(2, std::memory_order_relaxed);
+        }
+      }
+      if (io_ok) {
+        uint8_t status = reply->empty()
+                             ? static_cast<uint8_t>(kStatusError)
+                             : static_cast<uint8_t>((*reply)[0]);
+        if (status == kStatusBusy) {
+          // admission shed this connection (and closed it server-side):
+          // fail over to the next replica NOW, no backoff burned
+          ::close(fd);
+          ctr.Add(kCtrBusyFailover);
+          failed_before = true;
+          now = NowMs();
+          if (now >= deadline) {
+            ctr.Add(kCtrDeadlineExceeded);
+            ctr.Add(kCtrCallFail);
+            return false;
+          }
+          if (++busy_streak >= static_cast<int>(reps.size())) {
+            // every replica shedding: pace the loop a little instead of
+            // hammering the cluster at wire speed
+            std::this_thread::sleep_for(std::chrono::milliseconds(2));
+            busy_streak = 0;
+          }
+          continue;  // same attempt, next replica
+        }
+        busy_streak = 0;
+        if (status == kStatusDeadline) {
+          // the server refused dead work — the budget is gone client-
+          // side too, so end the call; the connection stays healthy
+          {
+            std::lock_guard<std::mutex> l(rep->mu);
+            rep->idle.push_back(fd);
+          }
+          ctr.Add(kCtrDeadlineExceeded);
+          ctr.Add(kCtrCallFail);
+          return false;
+        }
+        if (failed_before) ctr.Add(kCtrFailover);
+        std::lock_guard<std::mutex> l(rep->mu);
+        rep->idle.push_back(fd);
+        return true;
+      }
+      ::close(fd);
       ctr.Add(kCtrQuarantine);
-      rep->bad_until_ms.store(now + quarantine_ms, std::memory_order_relaxed);
+      rep->bad_until_ms.store(now + quarantine_ms,
+                              std::memory_order_relaxed);
       failed_before = true;
-      continue;
+      break;  // next attempt
     }
-    if (SendFrame(fd, req) && RecvFrame(fd, reply)) {
-      if (failed_before) ctr.Add(kCtrFailover);
-      std::lock_guard<std::mutex> l(rep->mu);
-      rep->idle.push_back(fd);
-      return true;
-    }
-    ::close(fd);
-    ctr.Add(kCtrQuarantine);
-    rep->bad_until_ms.store(now + quarantine_ms, std::memory_order_relaxed);
-    failed_before = true;
   }
   ctr.Add(kCtrCallFail);
   return false;
@@ -311,6 +405,18 @@ bool RemoteGraph::Init(const std::string& config) {
   if (chunk_ids_ < 1) chunk_ids_ = 1;
   if (cfg.count("dispatch_workers"))
     dispatch_workers_ = std::stoi(cfg["dispatch_workers"]);
+  // wire_version=1 emulates a pre-envelope client (compat testing and an
+  // operational escape hatch); 2 forces the envelope; absent = negotiate
+  // per replica (the default — old servers are detected and downgraded).
+  int wire_version = 0;
+  if (cfg.count("wire_version")) {
+    wire_version = std::stoi(cfg["wire_version"]);
+    if (wire_version != 1 && wire_version != 2) {
+      error_ = "wire_version must be 1 or 2 (this build speaks " +
+               std::to_string(kWireVersion) + ")";
+      return false;
+    }
+  }
   // Dense-feature-row cache: default ON for remote graphs (the embedded
   // engine has no cache — its rows are already local memory); 0 disables.
   int cache_mb = 64;
@@ -381,6 +487,9 @@ bool RemoteGraph::Init(const std::string& config) {
       error_ = "no replicas for shard " + std::to_string(s);
       return false;
     }
+    // set before the kInfo fetches below so even Init's own calls speak
+    // the pinned version
+    if (wire_version) pools_[s].SetForcedWireVersion(wire_version);
     for (auto& [host, port] : shards[s]) pools_[s].AddReplica(host, port);
   }
 

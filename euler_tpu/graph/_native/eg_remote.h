@@ -65,6 +65,10 @@ class ConnPool {
     std::string host;
     int port = 0;
     std::atomic<int64_t> bad_until_ms{0};
+    // Negotiated wire version: 0 = unknown (send the v2 envelope and
+    // learn from the first reply), 1 = downgraded to raw v1 (old
+    // server), 2 = confirmed v2. See eg_wire.h for the contract.
+    std::atomic<int> wire_version{0};
     std::mutex mu;
     std::vector<int> idle;  // pooled connected sockets
   };
@@ -79,6 +83,11 @@ class ConnPool {
 
   size_t num_replicas() const;
 
+  // Pin every replica's wire version instead of negotiating: 1 emulates
+  // a pre-envelope client (raw v1 requests, no deadline stamped), 2
+  // forces the envelope unconditionally. 0 (default) negotiates.
+  void SetForcedWireVersion(int v) { forced_version_ = v; }
+
   // One request/reply exchange; retries across replicas with exponential
   // backoff (full jitter, base backoff_ms, capped at 2 s) between
   // attempts and an overall deadline spanning all of them (deadline_ms;
@@ -87,9 +96,22 @@ class ConnPool {
   // time spent in earlier attempts. Returns false when every attempt
   // failed or the deadline expired (reply undefined). Failure counters
   // (eg_stats.h Counters) record dial failures, retries, quarantines,
-  // failovers, deadline aborts, and exhausted calls. Thread-safe: chunked
-  // requests Call the same pool concurrently from several dispatcher
-  // workers, each exchange on its own pooled socket.
+  // failovers, deadline aborts, and exhausted calls.
+  //
+  // Server survivability reactions (wire v2, eg_admission.h):
+  //   * the call's REMAINING deadline is stamped into each attempt's
+  //     envelope, so a drowning server can refuse dead work;
+  //   * a kStatusBusy reply fails over to the next replica IMMEDIATELY —
+  //     no backoff burned, no quarantine (the server is alive, just
+  //     shedding), counted in busy_failovers; only the overall deadline
+  //     bounds a fully-busy cluster;
+  //   * a kStatusDeadline reply ends the call at once (the budget is
+  //     gone either way), counted like a client-side deadline abort;
+  //   * an old server's "unknown op" answer to the envelope downgrades
+  //     the replica to v1 and resends raw on the same connection
+  //     (wire_downgrades).
+  // Thread-safe: chunked requests Call the same pool concurrently from
+  // several dispatcher workers, each exchange on its own pooled socket.
   bool Call(const std::string& req, std::string* reply, int retries,
             int timeout_ms, int quarantine_ms, int backoff_ms = 20,
             int deadline_ms = 0) const;
@@ -98,6 +120,7 @@ class ConnPool {
   mutable std::mutex mu_;  // guards replicas_ (the vector, not the pools)
   std::vector<std::shared_ptr<Replica>> replicas_;
   mutable std::atomic<size_t> rr_{0};
+  int forced_version_ = 0;  // 0 = negotiate per replica
 };
 
 class RemoteGraph : public GraphAPI {

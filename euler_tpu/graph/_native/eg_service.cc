@@ -58,7 +58,10 @@ int CountPartitions(const std::string& dir) {
 
 bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
                     const std::string& host, int port,
-                    const std::string& registry_dir) {
+                    const std::string& registry_dir,
+                    const std::string& options) {
+  AdmissionOptions opt;
+  if (!ParseAdmissionOptions(options, &opt, &error_)) return false;
   shard_idx_ = shard_idx;
   shard_num_ = shard_num;
   num_partitions_ = CountPartitions(data_dir);
@@ -71,21 +74,21 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
     return false;
   }
   host_ = host.empty() ? "127.0.0.1" : host;
-  listen_fd_ = ListenTcp(host_, port, &port_);
-  if (listen_fd_ < 0) {
+  int listen_fd = ListenTcp(host_, port, &port_);
+  if (listen_fd < 0) {
     error_ = "cannot bind port " + std::to_string(port);
     return false;
   }
-  stopping_ = false;
-  accept_thread_ = std::thread([this] {
-    try {
-      AcceptLoop();
-    } catch (...) {
-      // an exception escaping a thread entry is std::terminate for the
-      // whole process (eg-lint: thread-catch); a dead accept loop just
-      // stops admitting new connections until the service restarts
-    }
-  });
+  if (!admission_.Start(
+          listen_fd, opt,
+          [this](const char* req, size_t len, std::string* reply) {
+            Dispatch(req, len, reply);
+          },
+          &error_)) {
+    ::close(listen_fd);
+    return false;
+  }
+  started_ = true;
 
   if (registry_dir.compare(0, 6, "tcp://") == 0) {
     // TCP registry (eg_registry.h): REG now, then heartbeat re-REG at a
@@ -161,20 +164,7 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
   return true;
 }
 
-void Service::Stop() {
-  if (listen_fd_ < 0) return;
-  stopping_ = true;
-  ::shutdown(listen_fd_, SHUT_RDWR);
-  ::close(listen_fd_);
-  if (accept_thread_.joinable()) accept_thread_.join();
-  listen_fd_ = -1;
-  {
-    std::lock_guard<std::mutex> l(mu_);
-    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
-  }
-  // Handlers are detached; wait for them to drain before we destruct.
-  while (active_conns_.load(std::memory_order_acquire) > 0)
-    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+void Service::Deregister() {
   if (!registry_file_.empty()) {
     ::unlink(registry_file_.c_str());
     registry_file_.clear();
@@ -185,62 +175,20 @@ void Service::Stop() {
   }
 }
 
-void Service::AcceptLoop() {
-  while (!stopping_) {
-    int fd = ::accept(listen_fd_, nullptr, nullptr);
-    if (fd < 0) {
-      if (stopping_) break;
-      continue;
-    }
-    {
-      std::lock_guard<std::mutex> l(mu_);
-      conn_fds_.insert(fd);
-    }
-    active_conns_.fetch_add(1, std::memory_order_acq_rel);
-    std::thread([this, fd] {
-      try {
-        HandleConn(fd);
-      } catch (...) {
-        // an exception escaping this detached thread is std::terminate
-        // for the whole service (eg-lint: thread-catch) — one hostile
-        // connection (e.g. a frame whose recv buffer cannot be
-        // allocated) must not take the shard down
-      }
-      // Deregister before close — outside HandleConn so it runs even
-      // when the handler throws: Stop() busy-waits on active_conns_ and
-      // only shuts down fds still in the set, so it can never touch a
-      // closed (possibly recycled) descriptor.
-      {
-        std::lock_guard<std::mutex> l(mu_);
-        conn_fds_.erase(fd);
-      }
-      ::close(fd);
-      active_conns_.fetch_sub(1, std::memory_order_acq_rel);
-    }).detach();
-  }
+void Service::Drain(int grace_ms) {
+  if (!started_) return;
+  // Leave discovery FIRST so clients route new work elsewhere while the
+  // in-flight tail finishes — the SIGTERM half of a rolling restart
+  // (DEPLOY.md runbook; registry TTL / re-discovery handles the rest).
+  Deregister();
+  admission_.Drain(grace_ms);
 }
 
-void Service::HandleConn(int fd) {
-  std::string req, reply;
-  while (!stopping_) {
-    if (!RecvFrame(fd, &req)) break;
-    reply.clear();
-    try {
-      Dispatch(req, &reply);
-    } catch (const std::exception& ex) {
-      // a malformed request must come back as an error reply, not tear
-      // down the connection
-      WireWriter e;
-      e.U8(1);
-      e.Str(std::string("server error: ") + ex.what());
-      reply = std::move(e.buf());
-    }
-    // kFaultServiceReply drops the computed reply on the floor and closes
-    // the connection — the client sees a mid-exchange reset and must
-    // retry (possibly re-running the request on another replica).
-    if (FaultHit(kFaultServiceReply)) break;
-    if (!SendFrame(fd, reply)) break;
-  }
+void Service::Stop() {
+  if (!started_) return;
+  Deregister();
+  admission_.Stop();
+  started_ = false;
 }
 
 namespace {
@@ -264,9 +212,10 @@ bool OversizedResult(int64_t elems, std::string* reply) {
 
 }  // namespace
 
-void Service::Dispatch(const std::string& req, std::string* reply) const {
+void Service::Dispatch(const char* req, size_t len,
+                       std::string* reply) const {
   eg::SpanTimer span(eg::kStatServiceRequest);
-  WireReader r(req);
+  WireReader r(req, len);
   uint8_t op = r.U8();
   WireWriter w;
   w.U8(0);  // ok status; overwritten on decode error below

@@ -3,26 +3,29 @@
 // Role equivalent of the reference's async gRPC server
 // (reference euler/service/graph_service.cc:112-168 — N completion queues ×
 // N threads of CallData state machines) re-shaped for the simpler wire
-// protocol: an accept loop + one handler thread per connection, each running
-// a read-decode-execute-reply loop. Clients multiplex by holding several
-// connections, so server-side concurrency = number of client connections —
-// the same effective model as CQ-per-core without the gRPC machinery.
+// protocol. Since the survivability rework the transport runs on the
+// bounded-admission layer (eg_admission.h): a poller multiplexes idle
+// connections, a FIXED handler pool (workers= option, default 2×cores)
+// runs read-decode-execute-reply turns, overload answers BUSY instead of
+// queueing unboundedly, and v2 requests carry a deadline the handlers
+// honor before computing (eg_wire.h envelope). Drain() supports rolling
+// restarts: deregister, stop accepting, finish in-flight, close.
 //
 // Discovery: instead of ZooKeeper ephemeral znodes
 // (reference euler/common/zk_server_register.cc:32-48 "<shard>#<ip:port>"
 // children), the service drops a registry file "<shard>#<host>_<port>" into
-// a shared directory (atomic rename; removed on Stop). On a TPU pod the
-// natural registry_dir is on the shared filesystem all hosts mount.
+// a shared directory (atomic rename; removed on Drain/Stop), or REGisters
+// with a TCP registry (eg_registry.h) and heartbeats to keep its TTL entry
+// alive. On a TPU pod the natural registry_dir is on the shared filesystem
+// all hosts mount.
 #ifndef EG_SERVICE_H_
 #define EG_SERVICE_H_
 
 #include <atomic>
-#include <mutex>
-#include <set>
 #include <string>
 #include <thread>
-#include <vector>
 
+#include "eg_admission.h"
 #include "eg_engine.h"
 
 namespace eg {
@@ -41,10 +44,19 @@ class Service {
   // registers there: either a shared directory (flat file) or
   // "tcp://host:port" of a RegistryServer (heartbeat re-registration keeps
   // the TTL entry alive — the ephemeral-znode analog, eg_registry.h).
-  // False + error() on failure.
+  // `options` is a "k=v;k=v" admission spec (workers/pending/max_conns/
+  // io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version — see
+  // eg_admission.h); unknown keys fail loudly. False + error() on failure.
   bool Start(const std::string& data_dir, int shard_idx, int shard_num,
              const std::string& host, int port,
-             const std::string& registry_dir);
+             const std::string& registry_dir,
+             const std::string& options = "");
+
+  // Rolling-restart half: deregister from discovery (flat file unlinked /
+  // UNREG sent), stop accepting, let in-flight requests finish (condvar,
+  // bounded by grace_ms; <0 = the drain_ms option), close every
+  // connection. Idempotent; Stop() runs it first.
+  void Drain(int grace_ms = -1);
   void Stop();
 
   int port() const { return port_; }
@@ -53,16 +65,19 @@ class Service {
   const Engine& engine() const { return engine_; }
 
  private:
-  void AcceptLoop();
-  void HandleConn(int fd);
-  // Decode one request, run it on the engine, encode the reply.
-  void Dispatch(const std::string& req, std::string* reply) const;
+  // Leave discovery: unlink the flat-file entry and/or stop the
+  // heartbeat thread (which UNREGs on its way out). Idempotent.
+  void Deregister();
+  // Decode one request body (envelope already stripped by the admission
+  // worker), run it on the engine, encode the reply.
+  void Dispatch(const char* req, size_t len, std::string* reply) const;
 
   Engine engine_;
   std::string error_;
   std::string host_;
   int port_ = 0;
   int shard_idx_ = 0, shard_num_ = 1, num_partitions_ = 1;
+  bool started_ = false;
   std::string registry_file_;
   // tcp:// registry registration (empty host = not in tcp mode)
   std::string reg_host_;
@@ -70,14 +85,7 @@ class Service {
   std::thread heartbeat_thread_;
   std::atomic<bool> heartbeat_stop_{false};
 
-  int listen_fd_ = -1;
-  std::atomic<bool> stopping_{false};
-  std::thread accept_thread_;
-  std::mutex mu_;  // guards conn_fds_
-  std::set<int> conn_fds_;
-  // Handler threads are detached; Stop() waits for this to drain so no
-  // handler can outlive the Service it references.
-  std::atomic<int> active_conns_{0};
+  AdmissionServer admission_;
 };
 
 }  // namespace eg

@@ -114,6 +114,20 @@ enum CounterId : int {
   kCtrRpcError,          // a per-shard op failed after all transport
                          // retries (its rows degraded to defaults, or the
                          // call raised under strict=)
+  // Server-side survivability ledger (eg_admission.h): how often the
+  // shard service shed, refused, or reclaimed work instead of wedging —
+  // plus the client-side reactions that keep those events invisible to
+  // training (fail-fast failover, wire downgrade).
+  kCtrBusyReject,        // admission answered BUSY instead of queueing
+  kCtrBusyFailover,      // client treated a BUSY reply as an immediate
+                         // failover (no backoff burned, no quarantine)
+  kCtrHandlerTimeout,    // a handler abandoned a wedged connection on an
+                         // SO_RCVTIMEO/SO_SNDTIMEO expiry (slot freed)
+  kCtrDeadlineReject,    // a handler refused a request whose stamped
+                         // deadline had already expired (no dead compute)
+  kCtrDraining,          // a server entered drain (dereg + finish + close)
+  kCtrWireDowngrade,     // a replica negotiated down to wire v1 (old
+                         // server detected on its first exchange)
   kCtrCount,
 };
 
@@ -122,7 +136,9 @@ const char* const kCounterNames[kCtrCount] = {
     "failovers",          "calls_failed",     "deadlines_exceeded",
     "frames_rejected",    "rediscoveries",    "heartbeat_misses",
     "ids_deduped",        "cache_hits",       "cache_misses",
-    "rpc_chunks",         "rpc_errors",
+    "rpc_chunks",         "rpc_errors",       "busy_rejects",
+    "busy_failovers",     "handler_timeouts", "deadline_rejects",
+    "draining",           "wire_downgrades",
 };
 
 class Counters {

@@ -18,31 +18,33 @@ namespace eg {
 
 namespace {
 
-bool WriteAll(int fd, const char* p, size_t n) {
+IoStatus WriteAll(int fd, const char* p, size_t n) {
   while (n) {
     ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
     if (w < 0) {
       if (errno == EINTR) continue;
-      return false;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return IoStatus::kTimeout;
+      return IoStatus::kClosed;
     }
     p += w;
     n -= static_cast<size_t>(w);
   }
-  return true;
+  return IoStatus::kOk;
 }
 
-bool ReadAll(int fd, char* p, size_t n) {
+IoStatus ReadAll(int fd, char* p, size_t n) {
   while (n) {
     ssize_t r = ::recv(fd, p, n, 0);
     if (r < 0) {
       if (errno == EINTR) continue;
-      return false;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return IoStatus::kTimeout;
+      return IoStatus::kClosed;
     }
-    if (r == 0) return false;  // peer closed
+    if (r == 0) return IoStatus::kClosed;  // peer closed
     p += r;
     n -= static_cast<size_t>(r);
   }
-  return true;
+  return IoStatus::kOk;
 }
 
 void SetTimeouts(int fd, int timeout_ms) {
@@ -56,32 +58,78 @@ void SetTimeouts(int fd, int timeout_ms) {
 
 }  // namespace
 
-bool SendFrame(int fd, const std::string& payload) {
-  if (FaultHit(kFaultSendFrame)) return false;
+IoStatus SendFrameEx(int fd, const std::string& payload) {
+  if (FaultHit(kFaultSendFrame)) return IoStatus::kClosed;
   uint32_t len = static_cast<uint32_t>(payload.size());
-  if (payload.size() > kMaxFrame) return false;
+  if (payload.size() > kMaxFrame) return IoStatus::kReject;
   char hdr[4];
   std::memcpy(hdr, &len, 4);
-  return WriteAll(fd, hdr, 4) && WriteAll(fd, payload.data(), payload.size());
+  IoStatus s = WriteAll(fd, hdr, 4);
+  if (s != IoStatus::kOk) return s;
+  return WriteAll(fd, payload.data(), payload.size());
 }
 
-bool RecvFrame(int fd, std::string* payload) {
+bool SendFrame(int fd, const std::string& payload) {
+  return SendFrameEx(fd, payload) == IoStatus::kOk;
+}
+
+IoStatus RecvFrameEx(int fd, std::string* payload) {
   char hdr[4];
-  if (!ReadAll(fd, hdr, 4)) return false;
+  IoStatus s = ReadAll(fd, hdr, 4);
+  if (s != IoStatus::kOk) return s;
   // Fires after the header — a frame demonstrably began arriving — so an
   // injected fault is a true mid-frame reset (bytes lost, connection
   // must be discarded). Deliberately NOT at entry: a server handler
   // parked between requests would otherwise draw from the stream while
   // idle, making fault accounting depend on scheduler timing.
-  if (FaultHit(kFaultRecvFrame)) return false;
+  if (FaultHit(kFaultRecvFrame)) return IoStatus::kClosed;
   uint32_t len;
   std::memcpy(&len, hdr, 4);
   if (len > kMaxFrame) {
     Counters::Global().Add(kCtrFrameReject);
-    return false;
+    return IoStatus::kReject;
   }
   payload->resize(len);
-  return len == 0 || ReadAll(fd, payload->data(), len);
+  if (len == 0) return IoStatus::kOk;
+  return ReadAll(fd, payload->data(), len);
+}
+
+bool RecvFrame(int fd, std::string* payload) {
+  return RecvFrameEx(fd, payload) == IoStatus::kOk;
+}
+
+// ---- wire v2 request envelope ----
+
+std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms) {
+  std::string out;
+  out.reserve(payload.size() + 10);
+  out.push_back(static_cast<char>(kWireEnvelope));
+  out.push_back(static_cast<char>(kWireVersion));
+  char buf[8];
+  std::memcpy(buf, &deadline_ms, 8);
+  out.append(buf, 8);
+  out.append(payload);
+  return out;
+}
+
+bool PeekEnvelope(const std::string& payload, Envelope* env) {
+  *env = Envelope();
+  if (payload.empty() ||
+      static_cast<uint8_t>(payload[0]) != kWireEnvelope)
+    return true;  // plain v1 request
+  if (payload.size() < 10) return false;  // truncated envelope header
+  env->versioned = true;
+  env->version = static_cast<uint8_t>(payload[1]);
+  std::memcpy(&env->deadline_ms, payload.data() + 2, 8);
+  env->body_off = 10;
+  return true;
+}
+
+std::string StatusReply(uint8_t status, const std::string& msg) {
+  WireWriter w;
+  w.U8(status);
+  w.Str(msg);
+  return std::move(w.buf());
 }
 
 int DialTcp(const std::string& host, int port, int timeout_ms) {

@@ -8,8 +8,24 @@
 // serialize/deserialize of exactly such arrays).
 //
 // Frame:   [u32 payload_len][payload]
-// Request: payload = [u8 op][args...]
-// Reply:   payload = [u8 status][body...]   status 0 = ok, else error string.
+// Request: payload = [u8 op][args...]                              (wire v1)
+//          [u8 0xE7][u8 version][i64 deadline_ms][u8 op][args...]  (wire v2)
+// Reply:   payload = [u8 status][body...]   status 0 = ok, else see
+//          WireStatus (1 = error string; 2 BUSY; 3 DEADLINE; 4 BADVERSION).
+//
+// Version negotiation (backward compatible in both directions):
+//   * v2 clients wrap every request in the 0xE7 envelope, stamping the
+//     call's REMAINING deadline budget (ms) so the server can refuse
+//     requests whose answers nobody will read.
+//   * v2 servers accept BOTH forms: a first byte in the op range is a
+//     v1 request (no deadline); 0xE7 opens an envelope. An envelope
+//     whose version is above the server's speaks back kStatusBadVersion
+//     with a plain-text explanation — never a hang or a crash.
+//   * a v1 server sees 0xE7 as an unknown op and answers its stock
+//     "unknown op 231" error with the connection still healthy; v2
+//     clients recognize exactly that reply on a replica's first
+//     exchange, mark the replica v1 (`wire_downgrades` counter), and
+//     resend the raw request on the same connection.
 #ifndef EG_WIRE_H_
 #define EG_WIRE_H_
 
@@ -55,6 +71,40 @@ enum WireOp : uint8_t {
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap
+
+// Highest request-envelope version this build speaks; stamped by clients
+// and checked by servers (see the negotiation contract above).
+constexpr uint8_t kWireVersion = 2;
+// Request-envelope marker. Deliberately far outside the op range so a v1
+// server classifies an enveloped request as an unknown op (clean error)
+// instead of misparsing it.
+constexpr uint8_t kWireEnvelope = 0xE7;
+
+// Reply status byte. v1 peers only know 0/1; every later code reads as a
+// generic refused frame there (counted, retried) — degraded, never wrong.
+enum WireStatus : uint8_t {
+  kStatusOk = 0,
+  kStatusError = 1,       // body = error string
+  kStatusBusy = 2,        // admission shed the connection; fail over NOW
+  kStatusDeadline = 3,    // request's stamped deadline expired server-side
+  kStatusBadVersion = 4,  // envelope version above the server's
+};
+
+// Parsed view of a request payload's (optional) envelope.
+struct Envelope {
+  bool versioned = false;   // payload opened with kWireEnvelope
+  uint8_t version = 1;      // stamped version (1 when not versioned)
+  int64_t deadline_ms = -1; // client's remaining budget; <0 = none stamped
+  size_t body_off = 0;      // offset of the v1 [u8 op][args...] body
+};
+
+// [kWireEnvelope][u8 kWireVersion][i64 deadline_ms] + payload.
+std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms);
+// Classify a request payload; false only for a TRUNCATED envelope (marker
+// present but header short) — a payload without the marker is v1, ok.
+bool PeekEnvelope(const std::string& payload, Envelope* env);
+// [u8 status][Str msg] reply payload.
+std::string StatusReply(uint8_t status, const std::string& msg);
 
 class WireWriter {
  public:
@@ -176,10 +226,25 @@ class WireReader {
 
 // ---- framed socket IO (implemented in eg_wire.cc) ----
 
+// Outcome of one framed IO op, for callers that must distinguish a
+// wedged peer (socket timeout — the handler-slot-freeing case) from a
+// clean close or a protocol rejection.
+enum class IoStatus {
+  kOk,
+  kClosed,   // peer closed / reset / write error
+  kTimeout,  // SO_RCVTIMEO / SO_SNDTIMEO expired mid-op
+  kReject,   // oversize declared length (counted in frames_rejected)
+};
+
 // Write [u32 len][payload]; false on error.
 bool SendFrame(int fd, const std::string& payload);
+// SendFrame distinguishing a send-buffer timeout (client stopped
+// reading) from a plain broken pipe.
+IoStatus SendFrameEx(int fd, const std::string& payload);
 // Read one frame into *payload; false on error/close/oversize.
 bool RecvFrame(int fd, std::string* payload);
+// RecvFrame distinguishing timeout/close/oversize (see IoStatus).
+IoStatus RecvFrameEx(int fd, std::string* payload);
 // Blocking TCP connect with send/recv timeouts + TCP_NODELAY; -1 on failure.
 int DialTcp(const std::string& host, int port, int timeout_ms);
 // Listen socket on host:port (port 0 = ephemeral); *bound_port receives the

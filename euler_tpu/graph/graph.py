@@ -129,6 +129,7 @@ class Graph:
         coalesce: bool | None = None,
         chunk_ids: int | None = None,
         dispatch_workers: int | None = None,
+        wire_version: int | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
         config: str | None = None,
@@ -146,7 +147,8 @@ class Graph:
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
             "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
             "fault_seed", "feature_cache_mb", "strict", "coalesce",
-            "chunk_ids", "dispatch_workers", "cache_dir", "stream", "init",
+            "chunk_ids", "dispatch_workers", "wire_version", "cache_dir",
+            "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -203,6 +205,11 @@ class Graph:
             coalesce = str2bool(coalesce)
         chunk_ids = pick("chunk_ids", chunk_ids, None)
         dispatch_workers = pick("dispatch_workers", dispatch_workers, None)
+        # wire_version=1 emulates a pre-envelope client (compat drills /
+        # operational escape hatch), 2 forces the v2 deadline envelope;
+        # None = negotiate per replica (old servers are auto-downgraded,
+        # counted in wire_downgrades)
+        wire_version = pick("wire_version", wire_version, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
@@ -241,6 +248,7 @@ class Graph:
                 ("feature_cache_mb", feature_cache_mb), ("strict", strict),
                 ("coalesce", coalesce), ("chunk_ids", chunk_ids),
                 ("dispatch_workers", dispatch_workers),
+                ("wire_version", wire_version),
             ):
                 if val is not None:
                     raise ValueError(
@@ -267,7 +275,7 @@ class Graph:
             fault=fault, fault_seed=fault_seed,
             feature_cache_mb=feature_cache_mb, strict=strict,
             coalesce=coalesce, chunk_ids=chunk_ids,
-            dispatch_workers=dispatch_workers,
+            dispatch_workers=dispatch_workers, wire_version=wire_version,
             cache_dir=cache_dir, stream=bool(stream),
         )
         self.mode = mode
@@ -390,6 +398,8 @@ class Graph:
                 conf += f";chunk_ids={int(p['chunk_ids'])}"
             if p["dispatch_workers"] is not None:
                 conf += f";dispatch_workers={int(p['dispatch_workers'])}"
+            if p["wire_version"] is not None:
+                conf += f";wire_version={int(p['wire_version'])}"
             if p["fault"] is not None:
                 # ';' is the k=v separator, so the fault grammar uses ','
                 # between failpoints (FAULTS.md)

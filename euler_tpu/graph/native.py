@@ -89,9 +89,11 @@ def lib() -> ctypes.CDLL:
     _sig(
         L.eg_service_start,
         p,
-        [c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_char_p],
+        [c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_char_p,
+         c.c_char_p],
     )
     _sig(L.eg_service_port, c.c_int, [p])
+    _sig(L.eg_service_drain, None, [p, c.c_int])
     _sig(L.eg_service_stop, None, [p])
     _sig(L.eg_registry_start, p, [c.c_char_p, c.c_int, c.c_int])
     _sig(L.eg_registry_port, c.c_int, [p])
@@ -228,9 +230,15 @@ def counters() -> dict:
     return {L.eg_counter_name(i).decode(): int(arr[i]) for i in range(n)}
 
 
-def counters_reset() -> None:
-    """Zero the native failure counters."""
+def reset_counters() -> None:
+    """Zero the native failure/efficiency counters (process-global) —
+    the clean-slate primitive tests and benches use instead of
+    before/after delta arithmetic over :func:`counters` snapshots."""
     lib().eg_counters_reset()
+
+
+# older spelling, kept so existing callers and muscle memory both work
+counters_reset = reset_counters
 
 
 def fault_config(spec: str, seed: int = 0) -> None:

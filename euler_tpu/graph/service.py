@@ -3,13 +3,21 @@
 Role equivalent of the reference's service launcher
 (reference euler/python/service.py:30-50, which ctypes-loads
 libeuler_service.so and runs StartService on a daemon thread): here the
-native Service (eg_service.cc) runs its own accept/handler threads, so
-``GraphService(...)`` returns as soon as the shard has loaded its partitions
-and bound its port. Discovery replaces ZooKeeper with either a flat-file
-registry directory (shared filesystem) or a TCP registry
-(``registry="tcp://host:port"`` of a euler_tpu.graph.registry server, for
-multi-host pods without a shared FS; the shard heartbeats to keep its
-TTL entry alive — see eg_registry.h).
+native Service (eg_service.cc) runs its own poller + bounded handler
+pool (eg_admission.h), so ``GraphService(...)`` returns as soon as the
+shard has loaded its partitions and bound its port. Discovery replaces
+ZooKeeper with either a flat-file registry directory (shared filesystem)
+or a TCP registry (``registry="tcp://host:port"`` of a
+euler_tpu.graph.registry server, for multi-host pods without a shared
+FS; the shard heartbeats to keep its TTL entry alive — see
+eg_registry.h).
+
+Survivability knobs (eg_admission.h): ``workers=`` bounds the handler
+pool (default 2x cores), ``pending=`` the admitted-work headroom beyond
+it — excess connections get a BUSY reply the client fails over on —
+and ``drain()`` runs the graceful half of a rolling restart
+(deregister -> finish in-flight -> close; DEPLOY.md runbook). The
+standalone process wires SIGTERM to exactly that drain.
 
 Also runnable as a standalone shard process:
     python -m euler_tpu.graph.service --data_dir d --shard_idx 0 \
@@ -32,6 +40,9 @@ class GraphService:
         host: str = "127.0.0.1",
         port: int = 0,
         registry: str | None = None,
+        workers: int | None = None,
+        pending: int | None = None,
+        options: str | None = None,
     ):
         self._lib = lib()
         from euler_tpu.graph import remote_fs
@@ -44,6 +55,16 @@ class GraphService:
             )
         else:
             data_dir = remote_fs.strip_local_scheme(data_dir)
+        # admission spec (eg_admission.h): the common knobs get kwargs,
+        # the long tail (max_conns, io_timeout_ms, idle_timeout_ms,
+        # linger_ms, drain_ms, wire_version) rides in options=
+        opts = []
+        if workers is not None:
+            opts.append(f"workers={int(workers)}")
+        if pending is not None:
+            opts.append(f"pending={int(pending)}")
+        if options:
+            opts.append(options)
         self._h = self._lib.eg_service_start(
             data_dir.encode(),
             shard_idx,
@@ -51,6 +72,7 @@ class GraphService:
             host.encode(),
             port,
             (registry or "").encode(),
+            ";".join(opts).encode(),
         )
         if not self._h:
             err = self._lib.eg_last_error().decode()
@@ -63,6 +85,14 @@ class GraphService:
     @property
     def address(self) -> str:
         return f"{self.host}:{self.port}"
+
+    def drain(self, grace_ms: int = 0) -> None:
+        """Graceful rolling-restart half: deregister from discovery,
+        stop accepting, let in-flight requests finish (bounded by
+        grace_ms; 0 = the service's drain_ms option, default 5 s), close
+        every connection. Idempotent; stop() still frees the handle."""
+        if getattr(self, "_h", None):
+            self._lib.eg_service_drain(self._h, int(grace_ms))
 
     def stop(self) -> None:
         if getattr(self, "_h", None):
@@ -87,6 +117,7 @@ class GraphService:
 def main() -> None:
     import argparse
     import signal
+    import sys
     import time
 
     ap = argparse.ArgumentParser(description="Run one graph-service shard.")
@@ -96,9 +127,21 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--registry", default=None)
+    ap.add_argument("--workers", type=int, default=None, help=(
+        "handler pool size (default: 2x cores). Bounded admission: "
+        "connections beyond workers+pending get a BUSY reply the "
+        "client fails over on"))
+    ap.add_argument("--pending", type=int, default=None, help=(
+        "admitted-work headroom beyond the handler pool before new "
+        "connections are answered BUSY (default 64)"))
+    ap.add_argument("--options", default=None, help=(
+        "extra k=v;k=v admission options (max_conns, io_timeout_ms, "
+        "idle_timeout_ms, linger_ms, drain_ms, wire_version — see "
+        "eg_admission.h)"))
     ap.add_argument("--fault", default="", help=(
         "deterministic failpoint spec injected in THIS shard process "
-        "(service_reply/recv_frame/heartbeat/... — see FAULTS.md)"))
+        "(service_reply/recv_frame/handler_stall/busy_force/... — see "
+        "FAULTS.md)"))
     ap.add_argument("--fault_seed", type=int, default=0)
     args = ap.parse_args()
     if args.fault:
@@ -112,6 +155,9 @@ def main() -> None:
         args.host,
         args.port,
         args.registry,
+        workers=args.workers,
+        pending=args.pending,
+        options=args.options,
     )
     print(
         f"graph shard {svc.shard_idx}/{svc.shard_num} serving on"
@@ -119,10 +165,22 @@ def main() -> None:
         flush=True,
     )
     stop = []
+    # SIGTERM runs the rolling-restart drain (DEPLOY.md runbook):
+    # deregister -> stop accepting -> finish in-flight -> close. SIGINT
+    # takes the same path — an operator ^C should not drop in-flight work.
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     signal.signal(signal.SIGINT, lambda *a: stop.append(1))
     while not stop:
         time.sleep(0.2)
+    svc.drain()
+    # server-side survivability ledger for the operator's terminal, via
+    # the same eg_counters_* ABI the console's `stats` command reads
+    from euler_tpu.graph.native import counters
+
+    served = {k: v for k, v in counters().items() if v}
+    if served:
+        print(f"shard {svc.shard_idx} drained; counters: {served}",
+              file=sys.stderr, flush=True)
     svc.stop()
 
 

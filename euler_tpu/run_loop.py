@@ -96,6 +96,14 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "address this process's graph shard binds and advertises "
         "(shared mode). Empty = auto: the interface that routes to a "
         "tcp:// registry host, else 127.0.0.1"))
+    p.add_argument("--service_workers", type=int, default=None, help=(
+        "shared mode: handler pool size of this process's shard service "
+        "(default: 2x cores). Connections beyond workers+pending get a "
+        "BUSY reply clients fail over on (eg_admission.h)"))
+    p.add_argument("--service_pending", type=int, default=None, help=(
+        "shared mode: admitted-work headroom beyond the shard service's "
+        "handler pool before new connections are answered BUSY "
+        "(default 64)"))
     p.add_argument("--shards", default="",
                    help="comma list of host:port (remote mode)")
     p.add_argument("--train_node_type", type=int, default=0)
@@ -294,6 +302,8 @@ def build_graph(args):
                 shard_num=args.num_processes,
                 host=service_host,
                 registry=args.registry,
+                workers=args.service_workers,
+                pending=args.service_pending,
             )
         )
         if tcp_registry:
@@ -790,7 +800,18 @@ def main(argv=None) -> int:
         from euler_tpu.graph import device as device_graph
 
         device_graph.set_kernel_mesh(None)
+        # transport + server survivability ledger (eg_counters_* ABI):
+        # in shared mode this process also served its shard, so the
+        # snapshot covers both sides — busy_rejects/handler_timeouts/
+        # deadline_rejects next to the client's retries/failovers
+        ledger = {k: v for k, v in euler_tpu.counters().items() if v}
+        if ledger:
+            log.info("transport/server counters: %s", ledger)
         for s in services:
+            # GraphService: finish in-flight shard requests before the
+            # teardown (the registry server has no drain phase)
+            if hasattr(s, "drain"):
+                s.drain()
             s.stop()
     return 0
 

@@ -200,7 +200,7 @@ def bench_config(reg: str, steps: int, batch: int, fanouts,
     g = euler_tpu.Graph(mode="remote", registry=reg, **graph_kwargs)
     try:
         run_workload(g, 1, batch, fanouts, feature_dim)  # warm dials/cache
-        native.counters_reset()
+        native.reset_counters()
         eps, dt, requested = run_workload(g, steps, batch, fanouts,
                                           feature_dim)
         ctr = native.counters()

@@ -47,6 +47,15 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_fault_injection.py tests/test_chaos_soak.py -q \
   -p no:cacheprovider || fail=1
 
+step "rolling-restart drill + connection storm + wire fuzz (DEPLOY.md runbook)"
+# Server-side survivability: SIGTERM-drain/restart of every shard
+# mid-training with zero failed calls, BUSY load-shedding under a
+# 32-client storm, and malformed-frame/wire-version fuzzing against a
+# live service.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_rolling_restart.py tests/test_wire_fuzz.py -q \
+  -p no:cacheprovider || fail=1
+
 step "python syntax floor (compileall)"
 # stdlib floor under the optional tools above: at minimum, every file parses
 python -m compileall -q euler_tpu tests scripts examples bench.py || fail=1

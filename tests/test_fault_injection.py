@@ -28,10 +28,14 @@ COUNTER_NAMES = {
     # remote hot-path efficiency ledger (PR 3): dedup/cache/chunking
     # wins plus op-level shard failures
     "ids_deduped", "cache_hits", "cache_misses", "rpc_chunks", "rpc_errors",
+    # server-side survivability ledger (PR 4): bounded admission, wedge
+    # timeouts, deadline refusals, drains, wire downgrades
+    "busy_rejects", "busy_failovers", "handler_timeouts",
+    "deadline_rejects", "draining", "wire_downgrades",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
-    "heartbeat",
+    "heartbeat", "accept", "handler_stall", "busy_force",
 }
 
 
@@ -39,10 +43,10 @@ FAULT_NAMES = {
 def _clean_faults():
     """No failpoint may outlive its test (process-global injector)."""
     native.fault_clear()
-    native.counters_reset()
+    native.reset_counters()
     yield
     native.fault_clear()
-    native.counters_reset()
+    native.reset_counters()
 
 
 @pytest.fixture(scope="module")
@@ -358,6 +362,89 @@ def test_rpc_errors_counts_exhausted_shard_call(shard):
         assert ctr["calls_failed"] == 1, ctr
     finally:
         native.fault_clear()
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# server-side survivability failpoints (eg_admission.cc): BUSY shedding,
+# handler stalls -> deadline replies, accept-path drops — each counted
+# exactly
+# ---------------------------------------------------------------------------
+
+
+def test_busy_force_fail_fast_failover(shard):
+    """A forced-BUSY admission answer must trigger the client's
+    fail-fast path: immediate redial, no retry burned, no backoff
+    slept, no quarantine of the (alive, just shedding) server."""
+    svc, reg = shard
+    # armed BEFORE the client exists: Init's kInfo call dials fresh, so
+    # each of the three forced BUSYs lands on a new connection
+    native.fault_config("busy_force:err@1.0#3", 7)
+    native.reset_counters()
+    g = Graph(mode="remote", registry=reg, retries=2, timeout_ms=2000)
+    try:
+        t = g.node_types(np.array([10, 11], dtype=np.int64))
+        np.testing.assert_array_equal(t, [0, 1])
+        assert native.fault_injected()["busy_force"] == 3
+        ctr = native.counters()
+        assert ctr["busy_rejects"] == 3, ctr
+        assert ctr["busy_failovers"] == 3, ctr
+        assert ctr["retries"] == 0, ctr       # BUSY burns no attempt
+        assert ctr["quarantines"] == 0, ctr   # and no quarantine
+        assert ctr["calls_failed"] == 0, ctr
+    finally:
+        g.close()
+
+
+def test_handler_stall_delay_forces_deadline_reply(shard):
+    """A stalled handler must answer DEADLINE instead of computing a
+    dead answer: the stall outlives the client's stamped budget, the
+    server refuses pre-dispatch (deadline_rejects), and the client ends
+    the call at once (deadlines_exceeded) instead of re-queueing work
+    nobody will read."""
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=5, timeout_ms=2000,
+              backoff_ms=1, deadline_ms=150)
+    try:
+        one = np.array([10], dtype=np.int64)
+        g.node_types(one)  # warm up: pooled conn, negotiated v2
+        native.fault_config("handler_stall:delay@400#1", 9)
+        native.reset_counters()
+        t0 = time.monotonic()
+        t = g.node_types(one)
+        elapsed = time.monotonic() - t0
+        assert t[0] == -1  # degraded to default, not wedged
+        assert elapsed < 1.5, "DEADLINE reply did not end the call"
+        assert native.fault_injected()["handler_stall"] == 1
+        ctr = native.counters()
+        assert ctr["deadline_rejects"] == 1, ctr   # server side
+        assert ctr["deadlines_exceeded"] == 1, ctr  # client side
+        assert ctr["calls_failed"] == 1, ctr
+        assert ctr["retries"] == 0, ctr  # no retry of dead work
+    finally:
+        native.fault_clear()
+        g.close()
+
+
+def test_accept_fault_drops_connection_and_client_retries(shard):
+    """accept:err drops the freshly-accepted connection on the floor —
+    the client sees a mid-exchange reset on a connection that dialed
+    fine, and must recover through the ordinary retry path."""
+    svc, reg = shard
+    native.fault_config("accept:err@1.0#1", 11)
+    native.reset_counters()
+    g = Graph(mode="remote", registry=reg, retries=3, timeout_ms=2000,
+              backoff_ms=1)
+    try:
+        t = g.node_types(np.array([10, 11], dtype=np.int64))
+        np.testing.assert_array_equal(t, [0, 1])
+        assert native.fault_injected()["accept"] == 1
+        ctr = native.counters()
+        assert ctr["retries"] == 1, ctr
+        assert ctr["quarantines"] == 1, ctr
+        assert ctr["failovers"] == 1, ctr
+        assert ctr["dials_failed"] == 0, ctr  # the connect itself worked
+    finally:
         g.close()
 
 

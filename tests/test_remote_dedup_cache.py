@@ -117,9 +117,9 @@ def pl_cluster(tmp_path_factory):
 
 @pytest.fixture(autouse=True)
 def _clean_counters():
-    native.counters_reset()
+    native.reset_counters()
     yield
-    native.counters_reset()
+    native.reset_counters()
 
 
 def hub_heavy_ids(n=600, seed=3):
@@ -219,7 +219,7 @@ def test_dedup_and_cache_counter_arithmetic(pl_cluster):
         ids = np.array([0, 1, 0, 2, 1, 0, 3, 0], dtype=np.int64)
         uniq = len(set(ids.tolist()))  # 4
         dups = len(ids) - uniq         # 4
-        native.counters_reset()
+        native.reset_counters()
         remote.get_dense_feature(ids, [0], [3])
         c = native.counters()
         assert c["ids_deduped"] == dups, c
@@ -231,7 +231,7 @@ def test_dedup_and_cache_counter_arithmetic(pl_cluster):
         assert c["cache_misses"] == uniq, c      # unchanged
         assert c["ids_deduped"] == 2 * dups, c
         # node_types dedups too (no cache: types ride the wire each call)
-        native.counters_reset()
+        native.reset_counters()
         remote.node_types(ids)
         c = native.counters()
         assert c["ids_deduped"] == dups, c
@@ -246,7 +246,7 @@ def test_cache_disabled_and_coalesce_disabled(pl_cluster):
                    coalesce=False)
     try:
         ids = hub_heavy_ids(200)
-        native.counters_reset()
+        native.reset_counters()
         for _ in range(2):
             np.testing.assert_allclose(
                 remote.get_dense_feature(ids, [0], [3]),
@@ -266,7 +266,7 @@ def test_chunking_arithmetic_and_parity(pl_cluster):
                    feature_cache_mb=0)
     try:
         ids = np.arange(NUM_NODES, dtype=np.int64)  # all unique
-        native.counters_reset()
+        native.reset_counters()
         np.testing.assert_array_equal(
             remote.node_types(ids), local.node_types(ids)
         )
@@ -295,7 +295,7 @@ def test_cache_stays_capacity_bounded(pl_cluster):
         # distinct (fids, dims) spec, i.e. a distinct cache key set
         for rep in range(20):
             remote.get_dense_feature(ids, [0], [512 + rep])
-        native.counters_reset()
+        native.reset_counters()
         # the first spec's rows are the oldest everywhere: a bounded FIFO
         # must have evicted (essentially) all of them by now
         remote.get_dense_feature(ids, [0], [512])
@@ -325,7 +325,7 @@ def test_fanout_feature_batch_cuts_ids_on_wire_5x(pl_cluster):
         batch, f1, f2 = 64, 10, 10
         steps = 8
         requested = 0
-        native.counters_reset()
+        native.reset_counters()
         for step in range(steps):
             roots = np.asarray(local.sample_node(batch, -1))
             hop_ids, _, _ = remote.sample_fanout(
@@ -377,7 +377,7 @@ def test_strict_raises_on_dead_shard_and_recovers(pl_cluster):
             g.node_types(bad_ids), local.node_types(bad_ids)
         )
         svc1.stop()
-        native.counters_reset()
+        native.reset_counters()
         with pytest.raises(RuntimeError, match="shard 1"):
             g.node_types(bad_ids)
         assert native.counters()["rpc_errors"] >= 1
@@ -402,7 +402,7 @@ def test_default_mode_degrades_but_counts_rpc_errors(pl_cluster):
     try:
         svc1.stop()
         bad = np.array([1], dtype=np.int64)  # (1 % 4) % 2 == 1 -> shard 1
-        native.counters_reset()
+        native.reset_counters()
         t = g.node_types(bad)
         assert t[0] == -1  # silent default (the pre-strict contract)
         assert native.counters()["rpc_errors"] >= 1

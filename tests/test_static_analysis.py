@@ -42,14 +42,15 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 24, files  # all .cc and .h of _native
-    # the fault layer and the remote hot-path additions (persistent
-    # dispatcher + feature cache) must be under the gate, not
-    # grandfathered around it
+    assert len(files) >= 26, files  # all .cc and .h of _native
+    # the fault layer, the remote hot-path additions (persistent
+    # dispatcher + feature cache), and the server survivability layer
+    # (bounded admission) must be under the gate, not grandfathered
+    # around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
-        "eg_cache.cc", "eg_cache.h",
+        "eg_cache.cc", "eg_cache.h", "eg_admission.cc", "eg_admission.h",
     } <= names, names
     violations = []
     for f in files:
@@ -282,6 +283,59 @@ def test_wire_count_alloc_fires_on_config_derived_count():
     )
     (v,) = only_rule(lint(snippet), "wire-count-alloc")
     assert "npoints" in v.message
+
+
+# ---------------------------------------------------------------------------
+# admission-layer shapes: the bounded-admission server (eg_admission.cc)
+# stays under the same gate as the rest of the transport
+# ---------------------------------------------------------------------------
+
+
+def test_thread_catch_fires_on_poller_and_worker_pool_shapes():
+    """The admission layer spawns a poller std::thread AND a
+    vector<std::thread> worker pool — both entry shapes must stay under
+    thread-catch (an escaping exception is std::terminate for the whole
+    shard service)."""
+    snippet = (
+        "void Start(int n) {\n"
+        "  poller_ = std::thread([this] { PollerLoop(); });\n"
+        "  std::vector<std::thread> workers_;\n"
+        "  for (int i = 0; i < n; ++i)\n"
+        "    workers_.emplace_back([this] { WorkerLoop(); });\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "thread-catch")
+    assert [v.line for v in violations] == [2, 5]
+
+
+def test_wire_count_alloc_fires_on_envelope_derived_count():
+    """An admission worker sizing anything from an envelope-decoded
+    integer (e.g. a stamped deadline misused as a buffer size) is the
+    same bound-before-alloc crash class the wire reader rules pin."""
+    snippet = (
+        "void Serve(WireReader* r) {\n"
+        "  int64_t budget = r->I64();\n"
+        "  std::vector<char> scratch(budget);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert "budget" in v.message
+
+
+def test_raw_lock_fires_on_admission_queue_shape():
+    """The ready-queue handoff (poller push / worker pop) must stay
+    RAII-locked: a raw lock around the condvar queue is exactly where an
+    early return leaks a held mutex under load."""
+    snippet = (
+        "void Push(int fd) {\n"
+        "  mu_.lock();\n"
+        "  ready_.push_back(fd);\n"
+        "  mu_.unlock();\n"
+        "  ready_cv_.notify_one();\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
 
 
 # ---------------------------------------------------------------------------
