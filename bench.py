@@ -155,13 +155,19 @@ def detect_pallas_kernel(state) -> bool:
 
 
 def kernel_ab(model, opt, graph, batch_size: int, chunk_steps: int,
-              kernel_steps_per_sec: float, chunks: int = 4) -> dict:
+              kernel_steps_per_sec: float, chunks: int = 4,
+              put=None) -> dict:
     """Measure the SAME config with the Pallas kernel forced off and
     return {xla_path_steps_per_sec, kernel_step_speedup} (or
     {ab_error}). Shared by run_config's headline A/B and the batch
     sweep's per-point A/B — the env-toggle save/run/restore protocol
     must not fork. Caller must free its own kernel-path state first:
-    this uploads a second full state (slabs + params + opt)."""
+    this uploads a second full state (slabs + params + opt).
+
+    put: optional sharding for the XLA-path state (run_config passes
+    its replicated mesh sharding). The kernel-path measurement places
+    state_ds on `rep`; without the matching device_put here a
+    multi-chip mesh would compare different placements."""
     import jax
 
     from euler_tpu import train as train_lib
@@ -174,6 +180,8 @@ def kernel_ab(model, opt, graph, batch_size: int, chunk_steps: int,
             jax.random.PRNGKey(0), graph,
             graph.sample_node(batch_size, -1), opt,
         )
+        if put is not None:
+            state_x = jax.device_put(state_x, put)
         scan_x = jax.jit(
             train_lib.make_scan_train(model, opt, chunk_steps, batch_size),
             donate_argnums=(0,),
@@ -659,7 +667,7 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
         ):
             ds.update(kernel_ab(
                 model_ds, opt, graph, batch_size, chunk_steps,
-                ds["steps_per_sec"], chunks=4,
+                ds["steps_per_sec"], chunks=4, put=rep,
             ))
     except Exception as e:  # never lose the host-path number
         ds["error"] = f"{type(e).__name__}: {e}"[:300]

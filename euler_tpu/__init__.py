@@ -38,11 +38,21 @@ from euler_tpu.graph.native import (
     stats_reset,
 )
 from euler_tpu.graph.service import GraphService
+from euler_tpu.telemetry import (
+    metrics_text,
+    scrape,
+    set_telemetry,
+    slow_spans,
+    telemetry_json,
+    telemetry_reset,
+)
 
 __version__ = "0.2.0"
 
 __all__ = [
     "Graph", "GraphService", "convert", "convert_dicts", "stats",
     "stats_reset", "counters", "counters_reset", "reset_counters",
-    "fault_config", "fault_clear", "fault_injected",
+    "fault_config", "fault_clear", "fault_injected", "metrics_text",
+    "scrape", "set_telemetry", "slow_spans", "telemetry_json",
+    "telemetry_reset",
 ]

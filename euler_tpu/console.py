@@ -59,9 +59,11 @@ COMMANDS = {
         'walk "1, 2" "0" 5 1.0 2.0',
     ),
     "stats": (
-        "Show native span-timer stats (add 'reset' to zero them)",
-        "stats [reset]",
-        "stats",
+        "Show native stats: span timers + counters; 'hist' for latency "
+        "histograms (p50/p90/p99 per op), 'slow' for the slow-span "
+        "journal, 'reset' to zero everything",
+        "stats [hist|slow|reset]",
+        "stats hist",
     ),
     "quit": ("Exit the console", "quit", "quit"),
 }
@@ -254,9 +256,46 @@ class Console:
         )
 
         if args and args[0] == "reset":
+            from euler_tpu.telemetry import telemetry_reset
+
             stats_reset()
             counters_reset()
+            telemetry_reset()
             print("stats reset")
+            return
+        if args and args[0] == "hist":
+            # latency histograms (eg_telemetry): p50/p90/p99 per series
+            from euler_tpu.telemetry import percentiles, telemetry_json
+
+            rows = [
+                (key, h["count"], percentiles(h))
+                for key, h in sorted(telemetry_json()["hist"].items())
+                if h["count"] > 0
+            ]
+            if not rows:
+                print("no latency samples recorded")
+                return
+            print(f"{'series':36s} {'count':>8s} {'p50_us':>10s} "
+                  f"{'p90_us':>10s} {'p99_us':>10s}")
+            for key, count, pct in rows:
+                print(f"{key:36s} {count:8d} {pct[50]:10.1f} "
+                      f"{pct[90]:10.1f} {pct[99]:10.1f}")
+            return
+        if args and args[0] == "slow":
+            from euler_tpu.telemetry import slow_spans
+
+            spans = slow_spans()
+            if not spans:
+                print("slow-span journal empty")
+                return
+            print(f"{'side':6s} {'op':20s} {'shard':>5s} {'total_us':>9s} "
+                  f"{'queue':>7s} {'handler':>8s} {'wire':>7s} "
+                  f"{'outcome':8s} trace")
+            for s in spans:
+                print(f"{s['side']:6s} {s['op']:20s} {s['shard']:5d} "
+                      f"{s['total_us']:9d} {s['queue_us']:7d} "
+                      f"{s['handler_us']:8d} {s['wire_us']:7d} "
+                      f"{s['outcome']:8s} {s['trace']:#018x}")
             return
         snap = stats()
         if not snap:

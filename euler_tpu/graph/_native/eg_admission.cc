@@ -15,6 +15,7 @@
 
 #include "eg_fault.h"
 #include "eg_stats.h"
+#include "eg_telemetry.h"
 #include "eg_wire.h"
 
 namespace eg {
@@ -84,17 +85,27 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
     } else if (key == "drain_ms") {
       opt->drain_ms = v;
     } else if (key == "wire_version") {
-      if (v != 1 && v != 2) {
-        *err = "wire_version must be 1 or 2 (this build speaks " +
-               std::to_string(kWireVersion) + ")";
+      if (v < 1 || v > kWireVersion) {
+        *err = "wire_version must be 1.." + std::to_string(kWireVersion) +
+               " (this build speaks " + std::to_string(kWireVersion) + ")";
         return false;
       }
       opt->legacy_wire = v == 1;
+      opt->v2_only = v == 2;
+    } else if (key == "telemetry") {
+      opt->telemetry = v != 0 ? 1 : 0;
+    } else if (key == "slow_spans") {
+      if (v < 1) {
+        *err = "slow_spans must be >= 1 (journal capacity)";
+        return false;
+      }
+      opt->slow_spans = v;
     } else {
       // loudness rule: a typo'd key must not be dropped silently
       *err = "unknown service option '" + key +
              "' (known: workers, pending, max_conns, io_timeout_ms, "
-             "idle_timeout_ms, linger_ms, drain_ms, wire_version)";
+             "idle_timeout_ms, linger_ms, drain_ms, wire_version, "
+             "telemetry, slow_spans)";
       return false;
     }
   }
@@ -104,6 +115,13 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
 bool AdmissionServer::Start(int listen_fd, const AdmissionOptions& opt,
                             Handler handler, std::string* err) {
   opt_ = opt;
+  // telemetry=/slow_spans= options act on the process-global telemetry
+  // switch (eg_telemetry.h) — the server half of the kill-switch the
+  // client reaches through its graph config
+  if (opt_.telemetry >= 0)
+    Telemetry::Global().SetEnabled(opt_.telemetry != 0);
+  if (opt_.slow_spans > 0)
+    Telemetry::Global().SetSlowCapacity(opt_.slow_spans);
   if (opt_.workers <= 0) {
     unsigned hc = std::thread::hardware_concurrency();
     opt_.workers = 2 * static_cast<int>(hc ? hc : 2);
@@ -162,13 +180,13 @@ void AdmissionServer::Wake() {
 
 void AdmissionServer::CloseConn(int fd) {
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<PosixMutex> l(mu_);
     all_fds_.erase(fd);
   }
   ::close(fd);
   if (conns_.fetch_sub(1, std::memory_order_acq_rel) == 1 &&
       draining_.load(std::memory_order_acquire)) {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<PosixMutex> l(mu_);
     drained_cv_.notify_all();
   }
 }
@@ -176,7 +194,7 @@ void AdmissionServer::CloseConn(int fd) {
 void AdmissionServer::ReturnConn(int fd) {
   bool close_now;
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<PosixMutex> l(mu_);
     close_now = stop_ || draining_.load(std::memory_order_relaxed);
     if (!close_now) returned_.push_back(fd);
   }
@@ -231,7 +249,7 @@ void AdmissionServer::AcceptBurst(std::map<int, int64_t>* idle,
     }
     conns_.fetch_add(1, std::memory_order_acq_rel);
     {
-      std::lock_guard<std::mutex> l(mu_);
+      std::lock_guard<PosixMutex> l(mu_);
       all_fds_.insert(fd);
     }
     (*idle)[fd] = now;
@@ -249,7 +267,7 @@ void AdmissionServer::PollerLoop() {
   bool listen_open = listen_fd_ >= 0;
   for (;;) {
     {
-      std::lock_guard<std::mutex> l(mu_);
+      std::lock_guard<PosixMutex> l(mu_);
       if (stop_) break;
     }
     bool draining = draining_.load(std::memory_order_acquire);
@@ -284,7 +302,7 @@ void AdmissionServer::PollerLoop() {
     // conns workers handed back: re-arm (or close when draining raced)
     std::vector<int> back;
     {
-      std::lock_guard<std::mutex> l(mu_);
+      std::lock_guard<PosixMutex> l(mu_);
       back.swap(returned_);
     }
     for (int fd : back) {
@@ -304,7 +322,7 @@ void AdmissionServer::PollerLoop() {
       int fd = pfds[k].fd;
       if (idle.erase(fd) == 0) continue;  // already re-armed this cycle
       {
-        std::lock_guard<std::mutex> l(mu_);
+        std::lock_guard<PosixMutex> l(mu_);
         ready_.push_back({fd, now});
       }
       ready_count_.fetch_add(1, std::memory_order_acq_rel);
@@ -355,7 +373,7 @@ void AdmissionServer::WorkerLoop() {
     ReadyConn c;
     bool drop = false;
     {
-      std::unique_lock<std::mutex> l(mu_);
+      std::unique_lock<PosixMutex> l(mu_);
       ready_cv_.wait(l, [this] { return stop_ || !ready_.empty(); });
       if (ready_.empty()) return;  // stop_ and nothing left to drop
       c = ready_.front();
@@ -371,7 +389,7 @@ void AdmissionServer::WorkerLoop() {
     ServeConn(c);
     active_.fetch_sub(1, std::memory_order_acq_rel);
     if (draining_.load(std::memory_order_acquire)) {
-      std::lock_guard<std::mutex> l(mu_);
+      std::lock_guard<PosixMutex> l(mu_);
       drained_cv_.notify_all();
     }
   }
@@ -390,60 +408,117 @@ void AdmissionServer::ServeConn(ReadyConn c) {
       CloseConn(c.fd);
       return;
     }
+    // Telemetry (eg_telemetry.h): queue wait = poller-ready to here;
+    // handler time = everything between recv and the reply being ready
+    // (the stall failpoint included, so delay faults land requests in
+    // deterministic buckets); wire time = the reply send.
+    Telemetry& tel = Telemetry::Global();
+    const bool rec = tel.enabled();
+    uint64_t queue_us = 0;
+    if (rec) {
+      int64_t waited_ms = NowMs() - ready_ms;
+      queue_us = waited_ms > 0 ? static_cast<uint64_t>(waited_ms) * 1000
+                               : 0;
+      tel.Record(kHistServerQueue, 0, queue_us);
+    }
+    const int64_t t_handle = rec ? TelemetryNowUs() : 0;
     Envelope env;
+    uint8_t op = 0;
     reply.clear();
     if (!PeekEnvelope(req, &env)) {
       ctr.Add(kCtrFrameReject);
       reply = StatusReply(kStatusError, "truncated request envelope");
-    } else if (opt_.legacy_wire && env.versioned) {
-      // v1-server emulation (wire_version=1 option): answer exactly what
-      // a pre-envelope build answers, so the client's downgrade
-      // negotiation can be pinned against a real service
-      reply = StatusReply(kStatusError,
-                          "unknown op " + std::to_string(kWireEnvelope));
-    } else if (env.versioned && env.version > kWireVersion) {
-      ctr.Add(kCtrFrameReject);
-      reply = StatusReply(
-          kStatusBadVersion,
-          "unsupported wire version " + std::to_string(env.version) +
-              " (server speaks up to " + std::to_string(kWireVersion) +
-              ")");
     } else {
-      // kFaultHandlerStall sits between recv and the deadline check:
-      // a delay fault ages the request so the deadline path below fires
-      // deterministically; an err fault wedges the handler, which
-      // abandons the connection (the client sees a reset and retries)
-      if (FaultHit(kFaultHandlerStall)) {
-        CloseConn(c.fd);
-        return;
-      }
-      if (env.deadline_ms >= 0 && NowMs() - ready_ms > env.deadline_ms) {
-        // the client's budget is gone: an answer would be dead compute
-        ctr.Add(kCtrDeadlineReject);
-        reply = StatusReply(kStatusDeadline,
-                            "deadline expired before dispatch");
+      if (req.size() > env.body_off)
+        op = static_cast<uint8_t>(req[env.body_off]);
+      if (opt_.legacy_wire && env.versioned) {
+        // v1-server emulation (wire_version=1 option): answer exactly
+        // what a pre-envelope build answers, so the client's downgrade
+        // negotiation can be pinned against a real service
+        reply = StatusReply(kStatusError,
+                            "unknown op " + std::to_string(kWireEnvelope));
+      } else if (opt_.v2_only && env.versioned && env.version > 2) {
+        // v2-server emulation (wire_version=2 option): refuse the v3
+        // trace envelope the way a pre-telemetry build does, driving
+        // the client's pin-at-v2 downgrade path
+        ctr.Add(kCtrFrameReject);
+        reply = StatusReply(
+            kStatusBadVersion,
+            "unsupported wire version " + std::to_string(env.version) +
+                " (server speaks up to 2)");
+      } else if (env.versioned && env.version > kWireVersion) {
+        ctr.Add(kCtrFrameReject);
+        reply = StatusReply(
+            kStatusBadVersion,
+            "unsupported wire version " + std::to_string(env.version) +
+                " (server speaks up to " + std::to_string(kWireVersion) +
+                ")");
       } else {
-        try {
-          handler_(req.data() + env.body_off, req.size() - env.body_off,
-                   &reply);
-        } catch (const std::exception& ex) {
-          // a malformed request must come back as an error reply, not
-          // tear down the connection (let alone the worker)
-          reply = StatusReply(kStatusError,
-                              std::string("server error: ") + ex.what());
-        } catch (...) {
-          reply = StatusReply(kStatusError, "server error");
+        // kFaultHandlerStall sits between recv and the deadline check:
+        // a delay fault ages the request so the deadline path below
+        // fires deterministically; an err fault wedges the handler,
+        // which abandons the connection (the client sees a reset and
+        // retries)
+        if (FaultHit(kFaultHandlerStall)) {
+          CloseConn(c.fd);
+          return;
+        }
+        if (env.deadline_ms >= 0 && NowMs() - ready_ms > env.deadline_ms) {
+          // the client's budget is gone: an answer would be dead compute
+          ctr.Add(kCtrDeadlineReject);
+          reply = StatusReply(kStatusDeadline,
+                              "deadline expired before dispatch");
+        } else {
+          try {
+            handler_(req.data() + env.body_off, req.size() - env.body_off,
+                     &reply);
+          } catch (const std::exception& ex) {
+            // a malformed request must come back as an error reply, not
+            // tear down the connection (let alone the worker)
+            reply = StatusReply(kStatusError,
+                                std::string("server error: ") + ex.what());
+          } catch (...) {
+            reply = StatusReply(kStatusError, "server error");
+          }
         }
       }
     }
+    const uint64_t handler_us =
+        rec ? static_cast<uint64_t>(TelemetryNowUs() - t_handle) : 0;
+    if (rec) tel.Record(kHistServerHandler, op, handler_us);
+    const uint8_t status =
+        reply.empty() ? static_cast<uint8_t>(kStatusError)
+                      : static_cast<uint8_t>(reply[0]);
+    auto record_span = [&](uint64_t wire_us, uint8_t outcome) {
+      if (!rec) return;
+      TelemetrySpan sp;
+      sp.side = kSpanServer;
+      sp.op = op < kHistOpSlots ? op : 0;
+      sp.shard = opt_.shard_idx;
+      sp.trace = env.trace_id;
+      sp.queue_us = queue_us;
+      sp.handler_us = handler_us;
+      sp.wire_us = wire_us;
+      sp.total_us = queue_us + handler_us + wire_us;
+      sp.outcome = outcome;
+      tel.RecordSpan(sp);
+    };
     // kFaultServiceReply drops the computed reply on the floor and
     // closes the connection — the client sees a mid-exchange reset and
     // must retry (possibly on another replica).
     if (FaultHit(kFaultServiceReply)) {
+      record_span(0, kOutcomeDropped);
       CloseConn(c.fd);
       return;
     }
+    const int64_t t_send = rec ? TelemetryNowUs() : 0;
     IoStatus ss = SendFrameEx(c.fd, reply);
+    record_span(rec ? static_cast<uint64_t>(TelemetryNowUs() - t_send) : 0,
+                ss != IoStatus::kOk      ? kOutcomeDropped
+                : status == kStatusOk    ? kOutcomeOk
+                : status == kStatusBusy  ? kOutcomeBusy
+                : status == kStatusDeadline ? kOutcomeDeadline
+                                            : kOutcomeError);
     if (ss != IoStatus::kOk) {
       // kTimeout: the peer stopped reading and the send buffer filled —
       // again the socket timeout frees the slot
@@ -453,7 +528,7 @@ void AdmissionServer::ServeConn(ReadyConn c) {
     }
     bool stopping;
     {
-      std::lock_guard<std::mutex> l(mu_);
+      std::lock_guard<PosixMutex> l(mu_);
       stopping = stop_;
     }
     if (stopping || draining_.load(std::memory_order_acquire)) {
@@ -482,7 +557,7 @@ void AdmissionServer::Drain(int grace_ms) {
   if (!started_) return;
   bool first = false;
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<PosixMutex> l(mu_);
     if (!draining_.load(std::memory_order_relaxed)) {
       draining_.store(true, std::memory_order_release);
       first = true;
@@ -491,8 +566,8 @@ void AdmissionServer::Drain(int grace_ms) {
   if (first) Counters::Global().Add(kCtrDraining);
   Wake();
   if (grace_ms < 0) grace_ms = opt_.drain_ms;
-  std::unique_lock<std::mutex> l(mu_);
-  drained_cv_.wait_for(l, std::chrono::milliseconds(grace_ms), [this] {
+  std::unique_lock<PosixMutex> l(mu_);
+  drained_cv_.wait_for_ms(l, grace_ms, [this] {
     return conns_.load(std::memory_order_acquire) == 0;
   });
 }
@@ -501,7 +576,7 @@ void AdmissionServer::Stop() {
   if (!started_) return;
   Drain(-1);
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<PosixMutex> l(mu_);
     stop_ = true;
     // grace expired with work still in flight: force every blocked IO
     // to return so the joins below stay prompt
@@ -515,7 +590,7 @@ void AdmissionServer::Stop() {
   workers_.clear();
   std::set<int> leftover;
   {
-    std::lock_guard<std::mutex> l(mu_);
+    std::lock_guard<PosixMutex> l(mu_);
     leftover.swap(all_fds_);
     ready_.clear();
     returned_.clear();

@@ -49,6 +49,8 @@
 #include <thread>
 #include <vector>
 
+#include "eg_common.h"
+
 namespace eg {
 
 struct AdmissionOptions {
@@ -64,11 +66,20 @@ struct AdmissionOptions {
   bool legacy_wire = false;  // emulate a wire-v1 server (answer envelopes
                              // with the stock unknown-op error) — the
                              // cross-version compatibility test hook
+  bool v2_only = false;      // emulate a wire-v2 server (kStatusBadVersion
+                             // to v3 envelopes; v2 served normally) — the
+                             // trace-id downgrade drill's other direction
+  int telemetry = -1;        // -1 = leave the process-global telemetry
+                             // switch alone; 0/1 set it (eg_telemetry.h)
+  int slow_spans = 0;        // >0 = slow-span journal capacity
+  int shard_idx = -1;        // set programmatically by Service::Start so
+                             // server-side spans carry their shard
 };
 
 // Parse "k=v;k=v" admission options (workers/pending/max_conns/
-// io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version).
-// Unknown keys and malformed numbers fail loudly: false + *err.
+// io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version/
+// telemetry/slow_spans). Unknown keys and malformed numbers fail
+// loudly: false + *err.
 bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
                            std::string* err);
 
@@ -100,6 +111,14 @@ class AdmissionServer {
     return draining_.load(std::memory_order_acquire);
   }
   int workers() const { return opt_.workers; }
+  // Live admission gauges for the kStats scrape (eg_telemetry.h
+  // TelemetryGauges): how loaded this server is RIGHT NOW — the
+  // operator-visible half of bounded admission.
+  int active() const { return active_.load(std::memory_order_relaxed); }
+  int queue_depth() const {
+    return ready_count_.load(std::memory_order_relaxed);
+  }
+  int conns() const { return conns_.load(std::memory_order_relaxed); }
 
  private:
   struct ReadyConn {
@@ -127,9 +146,14 @@ class AdmissionServer {
   std::thread poller_;
   std::vector<std::thread> workers_;
 
-  mutable std::mutex mu_;  // guards ready_, returned_, all_fds_, stop_
-  std::condition_variable ready_cv_;    // workers wait for ready conns
-  std::condition_variable drained_cv_;  // Drain waits for conns_ == 0
+  // PosixMutex + condition_variable_any (not std::mutex): servers are
+  // created and destroyed repeatedly in one process (rolling restarts,
+  // tests), and a recycled heap block would otherwise carry the
+  // previous server's stale TSAN mutex shadow state — see PosixMutex
+  // in eg_common.h.
+  mutable PosixMutex mu_;  // guards ready_, returned_, all_fds_, stop_
+  PosixCondVar ready_cv_;    // workers wait for ready conns
+  PosixCondVar drained_cv_;  // Drain waits for conns_ == 0
   std::deque<ReadyConn> ready_;
   std::vector<int> returned_;
   std::set<int> all_fds_;  // every open conn fd, for forced shutdown

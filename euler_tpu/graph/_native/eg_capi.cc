@@ -17,6 +17,7 @@
 #include "eg_registry.h"
 #include "eg_sampling.h"
 #include "eg_stats.h"
+#include "eg_telemetry.h"
 #include "eg_remote.h"
 #include "eg_service.h"
 
@@ -191,8 +192,9 @@ int eg_remote_strict_error(void* h, char* buf, int cap) {
 // ---- graph service (StartService equivalent,
 // reference euler/service/python_api.cc:26-52) ----
 // `options` is the "k=v;k=v" admission spec (workers/pending/max_conns/
-// io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version — see
-// eg_admission.h); NULL/empty = defaults. Unknown keys fail loudly.
+// io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version/
+// telemetry/slow_spans — see eg_admission.h); NULL/empty = defaults.
+// Unknown keys fail loudly.
 void* eg_service_start(const char* data_dir, int shard_idx, int shard_num,
                        const char* host, int port, const char* registry_dir,
                        const char* options) {
@@ -632,6 +634,102 @@ void eg_counters_reset() {
     eg::Counters::Global().Reset();
   }
   EG_API_GUARD()
+}
+
+// ---- telemetry (eg_telemetry.h: latency histograms, slow-span
+// journals, the STATS scrape — see OBSERVABILITY.md) ----
+int eg_telemetry_enabled() {
+  try {
+    return eg::Telemetry::Global().enabled() ? 1 : 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+void eg_telemetry_set_enabled(int on) {
+  try {
+    eg::Telemetry::Global().SetEnabled(on != 0);
+  }
+  EG_API_GUARD()
+}
+
+// Zero histograms + the slow-span journal (enabled flag and journal
+// capacity survive — this is the clean-slate primitive tests use).
+void eg_telemetry_reset() {
+  try {
+    eg::Telemetry::Global().Reset();
+  }
+  EG_API_GUARD()
+}
+
+void eg_telemetry_set_slow_capacity(int n) {
+  try {
+    eg::Telemetry::Global().SetSlowCapacity(n);
+  }
+  EG_API_GUARD()
+}
+
+// Local telemetry dump as JSON (counters + stats + histograms + slow
+// spans; no admission gauges — those belong to a serving process and
+// ride the STATS scrape). Writes up to cap-1 bytes + NUL into buf and
+// returns the FULL length needed, so a caller seeing ret >= cap simply
+// retries with a bigger buffer. -1 on failure.
+int eg_telemetry_json(char* buf, int cap) {
+  try {
+    std::string js = eg::Telemetry::Global().Json(-1, nullptr);
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
+}
+
+// The span-record primitive the native sites use, exposed so Python can
+// journal app-level spans (run_loop step phases) and tests can pin the
+// journal's eviction order with exact microsecond values.
+void eg_telemetry_record_span(int side, int op, int outcome, int shard,
+                              uint64_t trace, uint64_t queue_us,
+                              uint64_t handler_us, uint64_t wire_us,
+                              uint64_t total_us) {
+  try {
+    eg::TelemetrySpan s;
+    s.side = side ? eg::kSpanServer : eg::kSpanClient;
+    s.op = op >= 0 && op < eg::kHistOpSlots ? static_cast<uint8_t>(op) : 0;
+    s.outcome = outcome >= 0 && outcome < 6 ? static_cast<uint8_t>(outcome)
+                                            : 1;
+    s.shard = shard;
+    s.trace = trace;
+    s.queue_us = queue_us;
+    s.handler_us = handler_us;
+    s.wire_us = wire_us;
+    s.total_us = total_us;
+    eg::Telemetry::Global().RecordSpan(s);
+  }
+  EG_API_GUARD()
+}
+
+// Remote scrape: fetch shard `shard`'s telemetry JSON over the STATS
+// wire opcode (retries/deadline per the graph's transport config). Same
+// buf/cap/return contract as eg_telemetry_json; -1 on transport failure
+// or bad shard index (see eg_last_error).
+int eg_remote_scrape(void* h, int shard, char* buf, int cap) {
+  try {
+    std::string js;
+    if (!static_cast<RemoteGraph*>(API(h))->ScrapeShard(shard, &js)) {
+      g_last_error = "telemetry scrape failed: shard " +
+                     std::to_string(shard) + " unreachable or invalid";
+      return -1;
+    }
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
 }
 
 // ---- deterministic failpoints (eg_fault.h; FAULTS.md) ----

@@ -8,8 +8,12 @@
 #ifndef EG_COMMON_H_
 #define EG_COMMON_H_
 
+#include <pthread.h>
+#include <time.h>
+
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -65,6 +69,86 @@ struct Rng {
 
 Rng& ThreadRng();
 void SeedThreadRng(uint64_t seed);
+
+// Mutex with a TSAN-visible lifecycle. std::mutex on Linux is
+// trivially constructed AND trivially destroyed (PTHREAD_MUTEX_
+// INITIALIZER, no init/destroy calls), so when an object holding one
+// is deleted and the allocator hands its block to a NEW object of the
+// same class, TSAN's shadow state for the old mutex survives at that
+// address and the new object's first lock reports a false "double
+// lock of a destroyed mutex" (reproduced on sequential Service
+// create/stop churn under `make tsan`; an address-size pad on the
+// PRE-telemetry tree reproduces it identically, pinning it as an
+// allocator-layout artifact, SANITIZERS.md round 9). Explicit
+// pthread_mutex_init/destroy are intercepted by TSAN and reset the
+// shadow state, so heap-recycled servers start clean. Satisfies
+// BasicLockable: use through std::lock_guard/std::unique_lock like
+// any std::mutex (the raw-lock lint rule applies to callers as usual).
+class PosixMutex {
+ public:
+  PosixMutex() { pthread_mutex_init(&m_, nullptr); }
+  ~PosixMutex() { pthread_mutex_destroy(&m_); }
+  PosixMutex(const PosixMutex&) = delete;
+  PosixMutex& operator=(const PosixMutex&) = delete;
+  void lock() { pthread_mutex_lock(&m_); }
+  void unlock() { pthread_mutex_unlock(&m_); }
+  bool try_lock() { return pthread_mutex_trylock(&m_) == 0; }
+  pthread_mutex_t* native() { return &m_; }
+
+ private:
+  pthread_mutex_t m_;
+};
+
+// Companion condition variable with the same TSAN-visible lifecycle.
+// NOT std::condition_variable (whose mutex type is fixed to
+// std::mutex) and NOT std::condition_variable_any (which allocates an
+// INTERNAL std::shared_ptr<std::mutex> — trivially initialized, so the
+// heap-recycling false positive above just moves inside it). Runs on a
+// CLOCK_MONOTONIC pthread_cond_t, so timed waits ignore wall-clock
+// jumps.
+class PosixCondVar {
+ public:
+  PosixCondVar() {
+    pthread_condattr_t attr;
+    pthread_condattr_init(&attr);
+    pthread_condattr_setclock(&attr, CLOCK_MONOTONIC);
+    pthread_cond_init(&c_, &attr);
+    pthread_condattr_destroy(&attr);
+  }
+  ~PosixCondVar() { pthread_cond_destroy(&c_); }
+  PosixCondVar(const PosixCondVar&) = delete;
+  PosixCondVar& operator=(const PosixCondVar&) = delete;
+
+  void notify_one() { pthread_cond_signal(&c_); }
+  void notify_all() { pthread_cond_broadcast(&c_); }
+
+  template <typename Pred>
+  void wait(std::unique_lock<PosixMutex>& l, Pred pred) {
+    while (!pred()) pthread_cond_wait(&c_, l.mutex()->native());
+  }
+
+  // Wait up to timeout_ms for pred; returns pred()'s final verdict.
+  template <typename Pred>
+  bool wait_for_ms(std::unique_lock<PosixMutex>& l, int64_t timeout_ms,
+                   Pred pred) {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    while (!pred()) {
+      if (pthread_cond_timedwait(&c_, l.mutex()->native(), &ts) != 0)
+        return pred();  // timeout (or error): report the final state
+    }
+    return true;
+  }
+
+ private:
+  pthread_cond_t c_;
+};
 
 // Little-endian cursor over a byte buffer; unaligned-safe via memcpy.
 // (Equivalent role to reference euler/common/bytes_reader.h:27.)

@@ -15,6 +15,7 @@
 #include "eg_fault.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
+#include "eg_telemetry.h"
 
 namespace eg {
 
@@ -141,6 +142,32 @@ size_t ConnPool::num_replicas() const {
 bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
                     int timeout_ms, int quarantine_ms, int backoff_ms,
                     int deadline_ms) const {
+  // Telemetry (eg_telemetry.h): the whole call — every retry, backoff
+  // and failover included — is one client_call histogram sample and one
+  // candidate slow span; the span's trace id rides the v3 envelope so
+  // the serving shard's journal shows the same request.
+  Telemetry& tel = Telemetry::Global();
+  const bool rec = tel.enabled();
+  const uint8_t op = req.empty() ? 0 : static_cast<uint8_t>(req[0]);
+  const uint64_t trace = rec ? NextTraceId() : 0;
+  const int64_t t_call = rec ? TelemetryNowUs() : 0;
+  uint64_t wire_us = 0;  // io time of the decisive (last) exchange
+  auto finish = [&](bool ok, uint8_t outcome) {
+    if (rec) {
+      uint64_t total = static_cast<uint64_t>(TelemetryNowUs() - t_call);
+      tel.Record(kHistClientCall, op, total);
+      TelemetrySpan sp;
+      sp.side = kSpanClient;
+      sp.op = op < kHistOpSlots ? op : 0;
+      sp.outcome = outcome;
+      sp.shard = shard_;
+      sp.trace = trace;
+      sp.wire_us = wire_us;
+      sp.total_us = total;
+      tel.RecordSpan(sp);
+    }
+    return ok;
+  };
   // snapshot: Update() may swap the set mid-call; shared_ptrs keep every
   // replica this exchange touches alive. Refreshed at every attempt
   // (below) so a call already mid-retry against a restarted shard picks
@@ -151,7 +178,7 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
     std::lock_guard<std::mutex> l(mu_);
     reps = replicas_;
   }
-  if (reps.empty()) return false;
+  if (reps.empty()) return finish(false, kOutcomeFailed);
   Counters& ctr = Counters::Global();
   // Overall wall-clock budget spanning every attempt; the 0 default keeps
   // the previous worst case (each attempt bounded by timeout_ms).
@@ -182,6 +209,9 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
       sleep_ms = std::min(sleep_ms, deadline - now);
       if (sleep_ms > 0) {
         std::this_thread::sleep_for(std::chrono::milliseconds(sleep_ms));
+        if (rec)
+          tel.Record(kHistBackoff, 0,
+                     static_cast<uint64_t>(sleep_ms) * 1000);
         now = NowMs();
       }
       if (now >= deadline) {
@@ -220,7 +250,13 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
           rep->idle.pop_back();
         }
       }
-      if (fd < 0) fd = DialTcp(rep->host, rep->port, timeout_ms);
+      if (fd < 0) {
+        const int64_t t_dial = rec ? TelemetryNowUs() : 0;
+        fd = DialTcp(rep->host, rep->port, timeout_ms);
+        if (rec)
+          tel.Record(kHistDial, 0,
+                     static_cast<uint64_t>(TelemetryNowUs() - t_dial));
+      }
       if (fd < 0) {
         ctr.Add(kCtrDialFail);
         ctr.Add(kCtrQuarantine);
@@ -236,14 +272,22 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
                     ? forced_version_
                     : rep->wire_version.load(std::memory_order_relaxed);
       bool sent_envelope = ver != 1;
+      auto exchange = [&](const std::string& payload) {
+        const int64_t t_io = rec ? TelemetryNowUs() : 0;
+        bool ok = SendFrame(fd, payload) && RecvFrame(fd, reply);
+        if (rec) wire_us = static_cast<uint64_t>(TelemetryNowUs() - t_io);
+        return ok;
+      };
       bool io_ok;
       if (sent_envelope) {
         int64_t remaining = deadline - NowMs();
         if (remaining < 0) remaining = 0;
-        io_ok = SendFrame(fd, WrapEnvelope(req, remaining)) &&
-                RecvFrame(fd, reply);
+        // negotiation (ver 0) probes with the full v3 trace envelope; a
+        // replica pinned at v2 keeps the deadline, drops the trace field
+        io_ok = exchange(WrapEnvelope(req, remaining,
+                                      ver == 2 ? 2 : kWireVersion, trace));
       } else {
-        io_ok = SendFrame(fd, req) && RecvFrame(fd, reply);
+        io_ok = exchange(req);
       }
       if (io_ok && sent_envelope && ver == 0) {
         // First exchange against this replica: learn its wire version.
@@ -252,9 +296,19 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
           ctr.Add(kCtrWireDowngrade);
           // the old server answered its stock error and kept the
           // connection healthy: resend the raw request on it
-          io_ok = SendFrame(fd, req) && RecvFrame(fd, reply);
-        } else {
+          io_ok = exchange(req);
+        } else if (!reply->empty() &&
+                   static_cast<uint8_t>((*reply)[0]) == kStatusBadVersion) {
+          // a v2-era server refused the v3 trace envelope with a clean
+          // versioned error: pin v2 (deadlines still propagate, the
+          // trace id just doesn't) and resend on the same connection
           rep->wire_version.store(2, std::memory_order_relaxed);
+          ctr.Add(kCtrWireDowngrade);
+          int64_t remaining = deadline - NowMs();
+          if (remaining < 0) remaining = 0;
+          io_ok = exchange(WrapEnvelope(req, remaining, 2, 0));
+        } else {
+          rep->wire_version.store(kWireVersion, std::memory_order_relaxed);
         }
       }
       if (io_ok) {
@@ -271,7 +325,7 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
           if (now >= deadline) {
             ctr.Add(kCtrDeadlineExceeded);
             ctr.Add(kCtrCallFail);
-            return false;
+            return finish(false, kOutcomeDeadline);
           }
           if (++busy_streak >= static_cast<int>(reps.size())) {
             // every replica shedding: pace the loop a little instead of
@@ -291,12 +345,14 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
           }
           ctr.Add(kCtrDeadlineExceeded);
           ctr.Add(kCtrCallFail);
-          return false;
+          return finish(false, kOutcomeDeadline);
         }
         if (failed_before) ctr.Add(kCtrFailover);
-        std::lock_guard<std::mutex> l(rep->mu);
-        rep->idle.push_back(fd);
-        return true;
+        {
+          std::lock_guard<std::mutex> l(rep->mu);
+          rep->idle.push_back(fd);
+        }
+        return finish(true, kOutcomeOk);
       }
       ::close(fd);
       ctr.Add(kCtrQuarantine);
@@ -307,7 +363,7 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
     }
   }
   ctr.Add(kCtrCallFail);
-  return false;
+  return finish(false, kOutcomeFailed);
 }
 
 // ---------------- RemoteGraph ----------------
@@ -406,16 +462,29 @@ bool RemoteGraph::Init(const std::string& config) {
   if (cfg.count("dispatch_workers"))
     dispatch_workers_ = std::stoi(cfg["dispatch_workers"]);
   // wire_version=1 emulates a pre-envelope client (compat testing and an
-  // operational escape hatch); 2 forces the envelope; absent = negotiate
-  // per replica (the default — old servers are detected and downgraded).
+  // operational escape hatch); 2 forces the deadline envelope without a
+  // trace id; 3 forces the full trace envelope; absent = negotiate per
+  // replica (the default — old servers are detected and downgraded).
   int wire_version = 0;
   if (cfg.count("wire_version")) {
     wire_version = std::stoi(cfg["wire_version"]);
-    if (wire_version != 1 && wire_version != 2) {
-      error_ = "wire_version must be 1 or 2 (this build speaks " +
-               std::to_string(kWireVersion) + ")";
+    if (wire_version < 1 || wire_version > kWireVersion) {
+      error_ = "wire_version must be 1.." + std::to_string(kWireVersion) +
+               " (this build speaks " + std::to_string(kWireVersion) + ")";
       return false;
     }
+  }
+  // Observability kill-switch + slow-span journal capacity
+  // (eg_telemetry.h) — process-global, like the failpoint registry.
+  if (cfg.count("telemetry"))
+    Telemetry::Global().SetEnabled(std::stoi(cfg["telemetry"]) != 0);
+  if (cfg.count("slow_spans")) {
+    int cap = std::stoi(cfg["slow_spans"]);
+    if (cap < 1) {
+      error_ = "slow_spans must be >= 1 (journal capacity)";
+      return false;
+    }
+    Telemetry::Global().SetSlowCapacity(cap);
   }
   // Dense-feature-row cache: default ON for remote graphs (the embedded
   // engine has no cache — its rows are already local memory); 0 disables.
@@ -490,6 +559,7 @@ bool RemoteGraph::Init(const std::string& config) {
     // set before the kInfo fetches below so even Init's own calls speak
     // the pinned version
     if (wire_version) pools_[s].SetForcedWireVersion(wire_version);
+    pools_[s].SetShard(s);
     for (auto& [host, port] : shards[s]) pools_[s].AddReplica(host, port);
   }
 
@@ -620,6 +690,18 @@ bool RemoteGraph::Call(int shard, const std::string& req,
     return false;
   }
   return true;
+}
+
+bool RemoteGraph::ScrapeShard(int shard, std::string* json) const {
+  if (shard < 0 || shard >= num_shards_) return false;
+  WireWriter req;
+  req.U8(kStats);
+  std::string reply;
+  if (!Call(shard, req.buf(), &reply)) return false;
+  WireReader r(reply);
+  r.U8();  // status already checked in Call
+  *json = r.Str();
+  return r.ok();
 }
 
 std::string RemoteGraph::TakeStrictError() const {

@@ -74,6 +74,9 @@ class ConnPool {
   };
 
   void AddReplica(const std::string& host, int port);
+  // Which shard this pool serves — stamped into client-side telemetry
+  // spans (eg_telemetry.h) so a slow call names its shard.
+  void SetShard(int s) { shard_ = s; }
   // Replace the replica set: existing (host, port) entries keep their
   // Replica object (pooled sockets + quarantine state survive), new
   // addresses are added, missing ones dropped. An empty `addrs` is a
@@ -85,7 +88,9 @@ class ConnPool {
 
   // Pin every replica's wire version instead of negotiating: 1 emulates
   // a pre-envelope client (raw v1 requests, no deadline stamped), 2
-  // forces the envelope unconditionally. 0 (default) negotiates.
+  // forces the deadline envelope without a trace id, 3 forces the full
+  // trace envelope. 0 (default) negotiates per replica (v3 probe; an
+  // old server's reply downgrades the replica to v1 or v2).
   void SetForcedWireVersion(int v) { forced_version_ = v; }
 
   // One request/reply exchange; retries across replicas with exponential
@@ -121,6 +126,7 @@ class ConnPool {
   std::vector<std::shared_ptr<Replica>> replicas_;
   mutable std::atomic<size_t> rr_{0};
   int forced_version_ = 0;  // 0 = negotiate per replica
+  int shard_ = -1;          // telemetry label only
 };
 
 class RemoteGraph : public GraphAPI {
@@ -156,6 +162,11 @@ class RemoteGraph : public GraphAPI {
   //     retries raises through the C ABI (eg_remote_strict_error) instead
   //     of silently degrading its rows to defaults. Either way the
   //     failure is counted in `rpc_errors`.
+  // Observability keys (eg_telemetry.h; process-global):
+  //   telemetry (default 1): 0 disables histograms + slow-span journals
+  //     (counters and stats keep recording — the kill-switch covers the
+  //     new subsystem only),
+  //   slow_spans (default 32): slowest-N span journal capacity.
   bool Init(const std::string& config);
   ~RemoteGraph() override;  // stops the re-discovery thread + dispatcher
   const std::string& error() const { return error_; }
@@ -166,6 +177,12 @@ class RemoteGraph : public GraphAPI {
     return shard >= 0 && shard < num_shards_ ? pools_[shard].num_replicas()
                                              : 0;
   }
+  // Telemetry scrape of one live shard (kStats opcode, eg_telemetry.h):
+  // the shard's counters + span-timer stats + latency histograms +
+  // admission gauges + slow-span journal as one JSON string — the same
+  // document Telemetry::Json builds locally, so scrape-vs-local parity
+  // is a field compare. False on transport failure / bad shard index.
+  bool ScrapeShard(int shard, std::string* json) const;
   // Pending strict-mode failure: copies + clears the first recorded
   // message. Empty string = no pending failure. (The fixed-shape query
   // ABI returns void, so strict failures surface through this side

@@ -12,6 +12,7 @@
 #include "eg_fault.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
+#include "eg_telemetry.h"
 #include "eg_wire.h"
 
 namespace eg {
@@ -62,6 +63,7 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
                     const std::string& options) {
   AdmissionOptions opt;
   if (!ParseAdmissionOptions(options, &opt, &error_)) return false;
+  opt.shard_idx = shard_idx;  // server-side telemetry spans carry it
   shard_idx_ = shard_idx;
   shard_num_ = shard_num;
   num_partitions_ = CountPartitions(data_dir);
@@ -223,6 +225,20 @@ void Service::Dispatch(const char* req, size_t len,
   switch (op) {
     case kPing:
       break;
+    case kStats: {
+      // Remote telemetry scrape (eg_telemetry.h): the same JSON the
+      // local euler_tpu.metrics_text() surface reads, plus this
+      // server's live admission gauges — so an operator can ask any
+      // shard how it is doing without shelling into its host.
+      TelemetryGauges g;
+      g.workers = admission_.workers();
+      g.active = admission_.active();
+      g.queue_depth = admission_.queue_depth();
+      g.conns = admission_.conns();
+      g.draining = admission_.draining() ? 1 : 0;
+      w.Str(Telemetry::Global().Json(shard_idx_, &g));
+      break;
+    }
     case kInfo: {
       const GraphStore& s = engine_.store();
       w.I64(static_cast<int64_t>(s.num_nodes()));

@@ -100,14 +100,19 @@ bool RecvFrame(int fd, std::string* payload) {
 
 // ---- wire v2 request envelope ----
 
-std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms) {
+std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms,
+                         uint8_t version, uint64_t trace_id) {
   std::string out;
-  out.reserve(payload.size() + 10);
+  out.reserve(payload.size() + 18);
   out.push_back(static_cast<char>(kWireEnvelope));
-  out.push_back(static_cast<char>(kWireVersion));
+  out.push_back(static_cast<char>(version));
   char buf[8];
   std::memcpy(buf, &deadline_ms, 8);
   out.append(buf, 8);
+  if (version >= 3) {
+    std::memcpy(buf, &trace_id, 8);
+    out.append(buf, 8);
+  }
   out.append(payload);
   return out;
 }
@@ -122,6 +127,14 @@ bool PeekEnvelope(const std::string& payload, Envelope* env) {
   env->version = static_cast<uint8_t>(payload[1]);
   std::memcpy(&env->deadline_ms, payload.data() + 2, 8);
   env->body_off = 10;
+  if (env->version == 3) {
+    // exactly v3 reads the trace field; FUTURE versions keep the common
+    // 10-byte parse (the server answers kStatusBadVersion before the
+    // body offset could matter, so an unknown layout never misparses)
+    if (payload.size() < 18) return false;
+    std::memcpy(&env->trace_id, payload.data() + 10, 8);
+    env->body_off = 18;
+  }
   return true;
 }
 

@@ -9,23 +9,33 @@
 //
 // Frame:   [u32 payload_len][payload]
 // Request: payload = [u8 op][args...]                              (wire v1)
-//          [u8 0xE7][u8 version][i64 deadline_ms][u8 op][args...]  (wire v2)
+//          [u8 0xE7][u8 2][i64 deadline_ms][u8 op][args...]        (wire v2)
+//          [u8 0xE7][u8 3][i64 deadline_ms][u64 trace_id][u8 op][args...]
+//                                                                  (wire v3)
 // Reply:   payload = [u8 status][body...]   status 0 = ok, else see
 //          WireStatus (1 = error string; 2 BUSY; 3 DEADLINE; 4 BADVERSION).
 //
-// Version negotiation (backward compatible in both directions):
-//   * v2 clients wrap every request in the 0xE7 envelope, stamping the
-//     call's REMAINING deadline budget (ms) so the server can refuse
-//     requests whose answers nobody will read.
-//   * v2 servers accept BOTH forms: a first byte in the op range is a
-//     v1 request (no deadline); 0xE7 opens an envelope. An envelope
-//     whose version is above the server's speaks back kStatusBadVersion
-//     with a plain-text explanation — never a hang or a crash.
+// Version negotiation (backward compatible in every direction, all
+// passive — no extra handshake round trip, ever):
+//   * current clients wrap every request in the 0xE7 envelope, stamping
+//     the call's REMAINING deadline budget (ms) so the server can
+//     refuse requests whose answers nobody will read, and (v3) the
+//     call's trace id so both sides' slow-span journals correlate
+//     (eg_telemetry.h).
+//   * current servers accept ALL forms: a first byte in the op range is
+//     a v1 request (no deadline, no trace); 0xE7 opens an envelope,
+//     whose version byte selects the header layout (v2 = 10 bytes,
+//     v3 = 18). An envelope whose version is above the server's speaks
+//     back kStatusBadVersion with a plain-text explanation — never a
+//     hang or a crash.
 //   * a v1 server sees 0xE7 as an unknown op and answers its stock
-//     "unknown op 231" error with the connection still healthy; v2
-//     clients recognize exactly that reply on a replica's first
-//     exchange, mark the replica v1 (`wire_downgrades` counter), and
-//     resend the raw request on the same connection.
+//     "unknown op 231" error with the connection still healthy; clients
+//     recognize exactly that reply on a replica's first exchange, mark
+//     the replica v1 (`wire_downgrades` counter), and resend the raw
+//     request on the same connection. A v2-only server instead answers
+//     kStatusBadVersion to the v3 envelope; the client pins the replica
+//     at v2 (deadline propagates, trace id simply doesn't) and resends
+//     — same counter, same single-exchange cost.
 #ifndef EG_WIRE_H_
 #define EG_WIRE_H_
 
@@ -68,13 +78,19 @@ enum WireOp : uint8_t {
   // Request: [Arr u64 ids][Arr i32 reps][Arr i32 etypes][i32 count][u64 def]
   // Reply:   [Arr u64 nbr][Arr f32 w][Arr i32 t], each sum(reps)*count long.
   kSampleNeighborUniq = 16,
+  // Remote observability scrape (eg_telemetry.h): ask a live shard for
+  // its full telemetry dump — counters, span-timer stats, latency
+  // histograms, admission gauges, slow-span journal. Request: no args.
+  // Reply: [Str json] — the same JSON Telemetry::Json builds for the
+  // local surface, so scrape-vs-local parity is one string compare.
+  kStats = 17,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap
 
 // Highest request-envelope version this build speaks; stamped by clients
 // and checked by servers (see the negotiation contract above).
-constexpr uint8_t kWireVersion = 2;
+constexpr uint8_t kWireVersion = 3;
 // Request-envelope marker. Deliberately far outside the op range so a v1
 // server classifies an enveloped request as an unknown op (clean error)
 // instead of misparsing it.
@@ -95,13 +111,20 @@ struct Envelope {
   bool versioned = false;   // payload opened with kWireEnvelope
   uint8_t version = 1;      // stamped version (1 when not versioned)
   int64_t deadline_ms = -1; // client's remaining budget; <0 = none stamped
+  uint64_t trace_id = 0;    // v3 trace id; 0 = none propagated
   size_t body_off = 0;      // offset of the v1 [u8 op][args...] body
 };
 
-// [kWireEnvelope][u8 kWireVersion][i64 deadline_ms] + payload.
-std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms);
+// [kWireEnvelope][u8 version][i64 deadline_ms]([u64 trace_id] for v3)
+// + payload. `version` must be 2 or 3 (v2 has no trace-id field).
+std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms,
+                         uint8_t version = kWireVersion,
+                         uint64_t trace_id = 0);
 // Classify a request payload; false only for a TRUNCATED envelope (marker
-// present but header short) — a payload without the marker is v1, ok.
+// present but header short for its stamped version) — a payload without
+// the marker is v1, ok. Versions above kWireVersion parse the common
+// 10-byte prefix only (the caller rejects them with kStatusBadVersion
+// before the body would matter).
 bool PeekEnvelope(const std::string& payload, Envelope* env);
 // [u8 status][Str msg] reply payload.
 std::string StatusReply(uint8_t status, const std::string& msg);

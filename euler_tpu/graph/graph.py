@@ -130,6 +130,8 @@ class Graph:
         chunk_ids: int | None = None,
         dispatch_workers: int | None = None,
         wire_version: int | None = None,
+        telemetry: bool | None = None,
+        slow_spans: int | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
         config: str | None = None,
@@ -147,8 +149,8 @@ class Graph:
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
             "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
             "fault_seed", "feature_cache_mb", "strict", "coalesce",
-            "chunk_ids", "dispatch_workers", "wire_version", "cache_dir",
-            "stream", "init",
+            "chunk_ids", "dispatch_workers", "wire_version", "telemetry",
+            "slow_spans", "cache_dir", "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -210,6 +212,13 @@ class Graph:
         # None = negotiate per replica (old servers are auto-downgraded,
         # counted in wire_downgrades)
         wire_version = pick("wire_version", wire_version, None)
+        # observability (eg_telemetry.h; process-global like fault=):
+        # telemetry=0 kills histogram/slow-span recording, slow_spans=
+        # resizes the slowest-N journal
+        telemetry = pick("telemetry", telemetry, None)
+        if isinstance(telemetry, str):
+            telemetry = str2bool(telemetry)
+        slow_spans = pick("slow_spans", slow_spans, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
@@ -249,6 +258,7 @@ class Graph:
                 ("coalesce", coalesce), ("chunk_ids", chunk_ids),
                 ("dispatch_workers", dispatch_workers),
                 ("wire_version", wire_version),
+                ("telemetry", telemetry), ("slow_spans", slow_spans),
             ):
                 if val is not None:
                     raise ValueError(
@@ -276,6 +286,7 @@ class Graph:
             feature_cache_mb=feature_cache_mb, strict=strict,
             coalesce=coalesce, chunk_ids=chunk_ids,
             dispatch_workers=dispatch_workers, wire_version=wire_version,
+            telemetry=telemetry, slow_spans=slow_spans,
             cache_dir=cache_dir, stream=bool(stream),
         )
         self.mode = mode
@@ -400,6 +411,10 @@ class Graph:
                 conf += f";dispatch_workers={int(p['dispatch_workers'])}"
             if p["wire_version"] is not None:
                 conf += f";wire_version={int(p['wire_version'])}"
+            if p["telemetry"] is not None:
+                conf += f";telemetry={1 if p['telemetry'] else 0}"
+            if p["slow_spans"] is not None:
+                conf += f";slow_spans={int(p['slow_spans'])}"
             if p["fault"] is not None:
                 # ';' is the k=v separator, so the fault grammar uses ','
                 # between failpoints (FAULTS.md)

@@ -76,6 +76,18 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_counter_name, c.c_char_p, [c.c_int])
     _sig(L.eg_counters_snapshot, None, [u64p])
     _sig(L.eg_counters_reset, None, [])
+    _sig(L.eg_telemetry_enabled, c.c_int, [])
+    _sig(L.eg_telemetry_set_enabled, None, [c.c_int])
+    _sig(L.eg_telemetry_reset, None, [])
+    _sig(L.eg_telemetry_set_slow_capacity, None, [c.c_int])
+    _sig(L.eg_telemetry_json, c.c_int, [c.c_char_p, c.c_int])
+    _sig(
+        L.eg_telemetry_record_span,
+        None,
+        [c.c_int, c.c_int, c.c_int, c.c_int, c.c_uint64, c.c_uint64,
+         c.c_uint64, c.c_uint64, c.c_uint64],
+    )
+    _sig(L.eg_remote_scrape, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
     _sig(L.eg_fault_config, c.c_int, [c.c_char_p, c.c_uint64])
     _sig(L.eg_fault_clear, None, [])
     _sig(L.eg_fault_count, c.c_int, [])

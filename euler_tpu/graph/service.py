@@ -57,7 +57,8 @@ class GraphService:
             data_dir = remote_fs.strip_local_scheme(data_dir)
         # admission spec (eg_admission.h): the common knobs get kwargs,
         # the long tail (max_conns, io_timeout_ms, idle_timeout_ms,
-        # linger_ms, drain_ms, wire_version) rides in options=
+        # linger_ms, drain_ms, wire_version, telemetry, slow_spans)
+        # rides in options=
         opts = []
         if workers is not None:
             opts.append(f"workers={int(workers)}")
@@ -136,7 +137,8 @@ def main() -> None:
         "connections are answered BUSY (default 64)"))
     ap.add_argument("--options", default=None, help=(
         "extra k=v;k=v admission options (max_conns, io_timeout_ms, "
-        "idle_timeout_ms, linger_ms, drain_ms, wire_version — see "
+        "idle_timeout_ms, linger_ms, drain_ms, wire_version, telemetry, "
+        "slow_spans — see "
         "eg_admission.h)"))
     ap.add_argument("--fault", default="", help=(
         "deterministic failpoint spec injected in THIS shard process "

@@ -194,6 +194,17 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
                        "tables (O(edges) memory, no truncation) instead "
                        "of padded slabs — the recommended form for "
                        "power-law graphs like real Reddit"))
+    p.add_argument("--metrics_every", type=int, default=0, help=(
+        "append a telemetry snapshot line (counters + per-op client "
+        "p50/p99 latency) to --metrics_file every N training steps; "
+        "0 disables (OBSERVABILITY.md)"))
+    p.add_argument("--metrics_file", default="", help=(
+        "JSONL path for --metrics_every snapshots (default: "
+        "<model_dir>/metrics.jsonl)"))
+    p.add_argument("--telemetry", type=_str2bool, default=True, help=(
+        "process-global latency-histogram/slow-span recording "
+        "(eg_telemetry); 0 is the kill-switch — counters and span "
+        "timers keep working either way"))
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
     p.add_argument("--profile_dir", default="")
@@ -635,6 +646,19 @@ def run_train(model, graph, args, mesh):
     def source_fn(step):
         return np.asarray(graph.sample_node(batch, args.train_node_type))
 
+    step_hook = None
+    if args.metrics_every > 0:
+        from euler_tpu.telemetry import append_metrics_line
+
+        metrics_path = args.metrics_file or os.path.join(
+            args.model_dir or ".", "metrics.jsonl"
+        )
+        os.makedirs(os.path.dirname(metrics_path) or ".", exist_ok=True)
+
+        def step_hook(step, _path=metrics_path):
+            if step % args.metrics_every == 0:
+                append_metrics_line(_path, step)
+
     state, history = train_lib.train(
         model,
         graph,
@@ -649,6 +673,7 @@ def run_train(model, graph, args, mesh):
         prefetch_threads=args.prefetch_threads,
         checkpoint_dir=args.model_dir or None,
         profile_dir=args.profile_dir or None,
+        step_hook=step_hook,
     )
     return state, history
 
@@ -762,6 +787,12 @@ def main(argv=None) -> int:
             num_processes=args.num_processes,
             process_id=args.process_id,
         )
+    if not args.telemetry:
+        # kill-switch BEFORE any graph/service exists so not even the
+        # discovery calls record histograms
+        from euler_tpu.telemetry import set_telemetry
+
+        set_telemetry(False)
     graph, services = build_graph(args)
     try:
         mesh = make_mesh(args.num_devices, model_parallel=args.model_parallel)

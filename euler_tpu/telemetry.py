@@ -1,0 +1,321 @@
+"""Observability surface over the native telemetry subsystem.
+
+The native layer (graph/_native/eg_telemetry.{h,cc}) records log2-
+bucketed latency histograms per RPC op (client whole-call, server
+handler, server queue wait, dial, retry backoff), keeps a slowest-N
+span journal on each side correlated by wire-propagated trace ids, and
+answers the STATS wire opcode with one JSON dump of everything plus the
+admission gauges. This module is the Python half:
+
+    euler_tpu.metrics_text()            Prometheus text, local process
+    euler_tpu.metrics_text(graph=g)     every shard of a live cluster
+    euler_tpu.slow_spans()              local slow-span journal
+    euler_tpu.scrape(g, shard)          one shard's raw telemetry dict
+    euler_tpu.set_telemetry(False)      process-global kill-switch
+
+plus the percentile/bucket arithmetic shared with scripts/
+metrics_dump.py and the --metrics_every JSONL emitter used by run_loop.
+See OBSERVABILITY.md for the metric glossary and scrape runbook.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import time
+
+from euler_tpu.graph.native import lib
+
+# Bucket layout — MUST match eg_telemetry.h HistBucketOf: bucket 0 =
+# [0, 1µs); bucket b (1..26) = [2^(b-1), 2^b) µs; bucket 27 = [2^26, inf).
+NUM_BUCKETS = 28
+
+
+def bucket_of(us: int) -> int:
+    """Bucket index of a microsecond value (the Python twin of the
+    native HistBucketOf, pinned against it by tests)."""
+    if us <= 0:
+        return 0
+    b = int(us).bit_length()
+    return min(b, NUM_BUCKETS - 1)
+
+
+def bucket_edges_us() -> list:
+    """Upper bucket edges in µs (27 finite edges, last bucket +Inf)."""
+    return [1 << b for b in range(NUM_BUCKETS - 1)]
+
+
+def percentiles(hist: dict, qs=(50, 90, 99)) -> dict:
+    """Estimate percentiles from one histogram dict ({"b": [...],
+    "count": n, "sum_us": s}) by linear interpolation inside the
+    containing log2 bucket. Returns {q: µs float}; empty hist -> {}."""
+    buckets = hist["b"]
+    total = sum(buckets)
+    if total == 0:
+        return {}
+    out = {}
+    for q in qs:
+        rank = q / 100.0 * total
+        acc = 0.0
+        for b, n in enumerate(buckets):
+            if n == 0:
+                continue
+            if acc + n >= rank:
+                lo = 0.0 if b == 0 else float(1 << (b - 1))
+                # the open-ended last bucket gets a 2x-wide estimate span
+                hi = float(1 << b) if b < NUM_BUCKETS - 1 else lo * 2.0
+                frac = (rank - acc) / n
+                out[q] = lo + (hi - lo) * frac
+                break
+            acc += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# native calls
+# ---------------------------------------------------------------------------
+
+
+def _json_abi(call) -> dict:
+    """Run a (buf, cap) -> needed-length ABI call, growing the buffer
+    until the dump fits, and parse the JSON."""
+    cap = 1 << 16
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        n = call(buf, cap)
+        if n < 0:
+            raise RuntimeError(lib().eg_last_error().decode())
+        if n < cap:
+            return json.loads(buf.value.decode())
+        cap = n + 1
+
+
+def telemetry_json() -> dict:
+    """This process's full telemetry dump: counters, span-timer stats,
+    every histogram, the slow-span journal (no admission gauges — those
+    belong to a serving process and arrive via :func:`scrape`)."""
+    return _json_abi(lambda buf, cap: lib().eg_telemetry_json(buf, cap))
+
+
+def scrape(graph, shard: int) -> dict:
+    """Scrape one live shard's telemetry over the STATS wire opcode.
+
+    Returns the shard process's dump — same shape as
+    :func:`telemetry_json` plus a ``gauges`` section (handler pool size,
+    workers busy, queue depth, open conns, draining) — fetched with the
+    graph's ordinary transport config (retries, deadline, failover)."""
+    if getattr(graph, "mode", None) != "remote":
+        raise ValueError("scrape() needs a mode='remote' graph "
+                         "(a local graph IS this process: use "
+                         "telemetry_json())")
+    h = graph._h
+    return _json_abi(
+        lambda buf, cap: lib().eg_remote_scrape(h, shard, buf, cap)
+    )
+
+
+def telemetry_enabled() -> bool:
+    return lib().eg_telemetry_enabled() == 1
+
+
+def set_telemetry(on: bool) -> None:
+    """Process-global telemetry kill-switch (`telemetry=` config key):
+    False stops histogram + slow-span recording everywhere (counters
+    and span-timer stats keep working — they predate this subsystem)."""
+    lib().eg_telemetry_set_enabled(1 if on else 0)
+
+
+def telemetry_reset() -> None:
+    """Zero every histogram and both-side span journals (the enabled
+    flag and journal capacity survive)."""
+    lib().eg_telemetry_reset()
+
+
+def set_slow_capacity(n: int) -> None:
+    """Resize the slowest-N span journal (`slow_spans=` config key)."""
+    lib().eg_telemetry_set_slow_capacity(int(n))
+
+
+def record_span(total_us: int, op: int = 0, side: str = "client",
+                outcome: int = 0, shard: int = -1, trace: int = 0,
+                queue_us: int = 0, handler_us: int = 0,
+                wire_us: int = 0) -> None:
+    """Offer an app-level span to the local journal (the same primitive
+    the native transport sites use)."""
+    lib().eg_telemetry_record_span(
+        1 if side == "server" else 0, int(op), int(outcome), int(shard),
+        int(trace), int(queue_us), int(handler_us), int(wire_us),
+        int(total_us),
+    )
+
+
+def slow_spans(graph=None, shard: int | None = None) -> list:
+    """Slowest-N spans, slowest first: local journal by default, a live
+    shard's when (graph, shard) name one. Trace ids come back as
+    Python ints (0 = not propagated: v1/v2 peer or telemetry off)."""
+    data = telemetry_json() if graph is None else scrape(graph, shard)
+    spans = data["slow_spans"]
+    for s in spans:
+        s["trace"] = int(s["trace"])
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_HIST_FAMILIES = {
+    "client_call": ("eg_client_call_latency_us",
+                    "Client whole-call latency per RPC op (retries "
+                    "included), microseconds"),
+    "server_handler": ("eg_server_handler_latency_us",
+                       "Server handler time per RPC op (decode + "
+                       "execute + encode), microseconds"),
+    "server_queue": ("eg_server_queue_wait_us",
+                     "Poller-ready to handler pickup wait, microseconds"),
+    "dial": ("eg_dial_latency_us", "DialTcp latency, microseconds"),
+    "backoff": ("eg_retry_backoff_us",
+                "Retry backoff sleeps, microseconds"),
+}
+
+_GAUGE_FAMILIES = {
+    "workers": ("eg_workers", "Fixed handler pool size"),
+    "workers_active": ("eg_workers_active", "Workers currently serving"),
+    "queue_depth": ("eg_queue_depth",
+                    "Ready connections waiting for a worker"),
+    "conns": ("eg_conns", "Admitted open connections"),
+    "draining": ("eg_draining", "1 while the server drains"),
+}
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _render(sources: list) -> str:
+    """Render [(telemetry dict, base labels), ...] as one Prometheus
+    text exposition — families emitted once, series per source."""
+    lines = []
+    edges = bucket_edges_us()
+
+    for kind, (fam, help_text) in _HIST_FAMILIES.items():
+        lines.append(f"# HELP {fam} {help_text}")
+        lines.append(f"# TYPE {fam} histogram")
+        for data, base in sources:
+            for key, h in sorted(data["hist"].items()):
+                k, _, op = key.partition(":")
+                if k != kind:
+                    continue
+                labels = dict(base)
+                if op:
+                    labels["op"] = op
+                cum = 0
+                for b, n in enumerate(h["b"]):
+                    cum += n
+                    le = str(edges[b]) if b < len(edges) else "+Inf"
+                    bl = dict(labels)
+                    bl["le"] = le
+                    lines.append(f"{fam}_bucket{_fmt_labels(bl)} {cum}")
+                lines.append(
+                    f"{fam}_sum{_fmt_labels(labels)} {h['sum_us']}"
+                )
+                lines.append(
+                    f"{fam}_count{_fmt_labels(labels)} {h['count']}"
+                )
+
+    lines.append("# HELP eg_counter_total Transport/server event "
+                 "counters (see FAULTS.md)")
+    lines.append("# TYPE eg_counter_total counter")
+    for data, base in sources:
+        for name, v in sorted(data["counters"].items()):
+            labels = dict(base)
+            labels["name"] = name
+            lines.append(f"eg_counter_total{_fmt_labels(labels)} {v}")
+
+    lines.append("# HELP eg_stat_calls_total Span-timer call counts "
+                 "per engine op")
+    lines.append("# TYPE eg_stat_calls_total counter")
+    for data, base in sources:
+        for name, (count, total_ns, max_ns) in sorted(
+            data["stats"].items()
+        ):
+            labels = dict(base)
+            labels["op"] = name
+            lines.append(
+                f"eg_stat_calls_total{_fmt_labels(labels)} {count}"
+            )
+
+    for gkey, (fam, help_text) in _GAUGE_FAMILIES.items():
+        emitted_header = False
+        for data, base in sources:
+            gauges = data.get("gauges")
+            if gauges is None or gkey not in gauges:
+                continue
+            if not emitted_header:
+                lines.append(f"# HELP {fam} {help_text}")
+                lines.append(f"# TYPE {fam} gauge")
+                emitted_header = True
+            lines.append(f"{fam}{_fmt_labels(dict(base))} {gauges[gkey]}")
+
+    return "\n".join(lines) + "\n"
+
+
+def metrics_text(graph=None, shard: int | None = None) -> str:
+    """Prometheus text exposition of the telemetry state.
+
+    * no arguments — this process (training client, or a shard served
+      in-process);
+    * ``graph`` (remote mode) — scrape every shard of the live cluster
+      over the STATS opcode, one series set per shard (label
+      ``shard="N"``); pass ``shard=`` to scrape just one.
+
+    Every RPC op appears in both the client_call and server_handler
+    histogram families even at zero count, so dashboards can be built
+    before traffic exists."""
+    if graph is None:
+        return _render([(telemetry_json(), {})])
+    shards = [shard] if shard is not None else list(
+        range(graph.num_shards)
+    )
+    return _render(
+        [(scrape(graph, s), {"shard": str(s)}) for s in shards]
+    )
+
+
+# ---------------------------------------------------------------------------
+# JSONL emission (run_loop --metrics_every)
+# ---------------------------------------------------------------------------
+
+
+def snapshot(step: int | None = None) -> dict:
+    """One compact metrics record for periodic JSONL emission: non-zero
+    counters, per-op client-call count + p50/p99 µs, gauges-free (local
+    process)."""
+    data = telemetry_json()
+    ops = {}
+    for key, h in data["hist"].items():
+        kind, _, op = key.partition(":")
+        if kind != "client_call" or h["count"] == 0:
+            continue
+        pct = percentiles(h, (50, 99))
+        ops[op] = {
+            "count": h["count"],
+            "p50_us": round(pct.get(50, 0.0), 1),
+            "p99_us": round(pct.get(99, 0.0), 1),
+        }
+    return {
+        "step": step,
+        "unix_ms": int(time.time() * 1000),
+        "counters": {k: v for k, v in data["counters"].items() if v},
+        "ops": ops,
+    }
+
+
+def append_metrics_line(path: str, step: int | None = None) -> None:
+    """Append one :func:`snapshot` line to a JSONL file (the
+    ``run_loop --metrics_every=N`` emitter)."""
+    with open(path, "a") as f:
+        f.write(json.dumps(snapshot(step)) + "\n")

@@ -87,8 +87,13 @@ def train(
     profile_steps: tuple = (10, 20),
     device_prefetch: bool = True,
     sync_every: Optional[int] = None,
+    step_hook=None,
 ):
     """Train and return (state, history).
+
+    step_hook(step) runs on the training thread after every dispatched
+    step (run_loop's --metrics_every JSONL emitter rides here; the hook
+    gates itself, so the per-step cost is one call + one modulo).
 
     source_fn(step) -> int64 root-node batch (fixed size, divisible by the
     mesh size). All sampling runs in the prefetch workers.
@@ -241,6 +246,8 @@ def train(
         state, last_loss, metric = step_fn(state, batch)
         window_metrics.append(metric)
         steps_done += 1
+        if step_hook is not None:
+            step_hook(steps_done)
         if sync_every and steps_done % sync_every == 0:
             jax.block_until_ready(last_loss)
         if profiling and steps_done - start_step >= profile_steps[1]:

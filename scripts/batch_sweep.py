@@ -226,14 +226,19 @@ def main() -> None:
     # the heavytail sweep does strictly more than bench's single point
     # (same graph load + alias upload, then a compile per batch point);
     # on TPU, ppi/reddit points also each pay the kernel A/B's second
-    # init_state + compile, hence the +700 below (CPU runs no A/B)
+    # init_state + compile, hence the +700 below (CPU runs no A/B, and
+    # neither does reddit_heavytail — its alias path skips the kernel
+    # A/B, so it must not inherit A/B headroom its points never spend)
     caps = {"reddit_heavytail": 2400.0}
+    no_ab = {"reddit_heavytail"}  # alias-path configs: no kernel A/B
     for name in [n.strip() for n in args.configs.split(",") if n.strip()]:
+        ab_bump = (
+            0.0 if (child_platform == "cpu" or name in no_ab) else 700.0
+        )
         deadline = (
             args.deadline
             if args.deadline is not None
-            else caps.get(name, 900.0)
-            + (0.0 if child_platform == "cpu" else 700.0)
+            else caps.get(name, 900.0) + ab_bump
         ) * (3.0 if child_platform == "cpu" else 1.0)
         cmd = [
             sys.executable, "-u", os.path.abspath(__file__),

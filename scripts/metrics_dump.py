@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Scrape a running euler_tpu cluster's telemetry and pretty-print it.
+
+Connects a remote client to a live cluster (registry dir, tcp://
+registry, or an explicit shard list), scrapes every shard over the
+STATS wire opcode (eg_telemetry), and prints per shard:
+
+  * admission gauges — handler pool size, workers busy, queue depth,
+    open connections, draining flag (the PR-4 survivability state an
+    operator previously had to shell into the host to see);
+  * per-op server handler latency: count + p50/p90/p99 µs from the
+    log2-bucketed histograms;
+  * non-zero counters (FAULTS.md glossary);
+  * the shard's slowest spans with their trace ids.
+
+Usage:
+    python scripts/metrics_dump.py --registry /shared/reg
+    python scripts/metrics_dump.py --shards h1:9001,h2:9001
+    python scripts/metrics_dump.py --registry tcp://host:9100 --json
+    python scripts/metrics_dump.py --smoke     # self-contained check
+                                               # (spins a tiny 2-shard
+                                               # cluster; verify.sh)
+
+See OBSERVABILITY.md for the runbook (watching a rolling restart
+through this scrape included).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def dump_cluster(graph, as_json: bool = False) -> list:
+    """Scrape every shard; print and return the per-shard dicts."""
+    from euler_tpu import telemetry as T
+
+    shards = []
+    for s in range(graph.num_shards):
+        data = T.scrape(graph, s)
+        shards.append(data)
+        if as_json:
+            continue
+        g = data.get("gauges", {})
+        print(f"== shard {data['shard']} ==")
+        print(
+            f"  workers {g.get('workers', '?')}"
+            f" busy {g.get('workers_active', '?')}"
+            f" queue {g.get('queue_depth', '?')}"
+            f" conns {g.get('conns', '?')}"
+            f" draining {g.get('draining', '?')}"
+        )
+        rows = [
+            (key.split(":", 1)[1], h)
+            for key, h in sorted(data["hist"].items())
+            if key.startswith("server_handler:") and h["count"] > 0
+        ]
+        if rows:
+            print(f"  {'op':22s} {'count':>8s} {'p50_us':>10s} "
+                  f"{'p90_us':>10s} {'p99_us':>10s}")
+            for op, h in rows:
+                pct = T.percentiles(h)
+                print(f"  {op:22s} {h['count']:8d} {pct[50]:10.1f} "
+                      f"{pct[90]:10.1f} {pct[99]:10.1f}")
+        else:
+            print("  no handler latency samples yet")
+        nonzero = {k: v for k, v in data["counters"].items() if v}
+        if nonzero:
+            print(f"  counters: {nonzero}")
+        for sp in data["slow_spans"][:5]:
+            print(f"  slow: {sp['op']:20s} {sp['total_us']:>9d}us "
+                  f"queue={sp['queue_us']} handler={sp['handler_us']} "
+                  f"wire={sp['wire_us']} outcome={sp['outcome']} "
+                  f"trace={int(sp['trace']):#x}")
+    if as_json:
+        print(json.dumps(shards))
+    return shards
+
+
+def run_smoke() -> int:
+    """Self-contained scrape check: tiny 2-shard in-process cluster,
+    a little traffic, then assert the scrape agrees with the wire's
+    ground truth (verify.sh gate)."""
+    import shutil
+    import tempfile
+
+    import euler_tpu
+    from euler_tpu import telemetry as T
+    from euler_tpu.graph.service import GraphService
+
+    sys.path.insert(0, REPO)
+    from scripts.remote_bench import build_powerlaw_fixture
+
+    tmp = tempfile.mkdtemp(prefix="euler_metrics_smoke_")
+    svcs = []
+    try:
+        data = os.path.join(tmp, "data")
+        os.makedirs(data)
+        build_powerlaw_fixture(data, 120, 6, 8)
+        svcs = [GraphService(data, s, 2) for s in range(2)]
+        g = euler_tpu.Graph(
+            mode="remote", shards=[s.address for s in svcs],
+            retries=2, timeout_ms=2000,
+        )
+        try:
+            T.telemetry_reset()
+            steps = 4
+            for _ in range(steps):
+                roots = g.sample_node(16, -1)
+                g.sample_fanout(roots, [[0, 1], [0, 1]], [3, 3])
+                g.get_dense_feature(roots, [0], [8])
+            shards = dump_cluster(g)
+            assert len(shards) == 2, shards
+            for data_s in shards:
+                assert "gauges" in data_s and data_s["gauges"]["workers"] > 0
+                served = sum(
+                    h["count"] for key, h in data_s["hist"].items()
+                    if key.startswith("server_handler:")
+                )
+                assert served > 0, data_s["hist"]
+            # in-process shards: the scrape and the local dump read the
+            # same globals — numbers must be identical where the scrape
+            # itself doesn't add samples (the stats op records AFTER its
+            # reply is built, so compare a family the scrape never touches)
+            local = T.telemetry_json()["hist"]
+            for data_s in shards[-1:]:
+                key = "server_handler:sample_node"
+                assert data_s["hist"][key]["b"] == local[key]["b"], key
+            # client side saw every op too
+            spans = T.slow_spans()
+            assert spans and any(s["side"] == "client" for s in spans)
+            print("metrics_dump smoke: OK")
+            return 0
+        finally:
+            g.close()
+    finally:
+        for s in svcs:
+            s.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--registry", default="", help=(
+        "registry dir or tcp://host:port the cluster registered with"))
+    ap.add_argument("--shards", default="", help=(
+        "explicit comma-separated host:port shard list"))
+    ap.add_argument("--timeout_ms", type=int, default=3000)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable: one JSON array of shard dumps")
+    ap.add_argument("--smoke", action="store_true", help=(
+        "spin a tiny local 2-shard cluster and assert the scrape "
+        "(the verify.sh gate)"))
+    args = ap.parse_args()
+
+    if args.smoke:
+        return run_smoke()
+    if not args.registry and not args.shards:
+        ap.error("need --registry or --shards (or --smoke)")
+
+    import euler_tpu
+
+    g = euler_tpu.Graph(
+        mode="remote",
+        registry=args.registry or None,
+        shards=args.shards.split(",") if args.shards else None,
+        retries=2,
+        timeout_ms=args.timeout_ms,
+        rediscover_ms=0,
+    )
+    try:
+        dump_cluster(g, as_json=args.json)
+    finally:
+        g.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -254,10 +254,24 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
             reg, steps, batch, fanouts, feature_dim, "baseline",
             coalesce=False, feature_cache_mb=0,
         )
-        # OPTIMIZED: defaults (coalesce on, cache on)
+        # OPTIMIZED: defaults (coalesce on, cache on, telemetry on)
         after = bench_config(
             reg, steps, batch, fanouts, feature_dim, "optimized",
+            telemetry=True,
         )
+        # TELEMETRY A/B: the optimized path with the observability
+        # kill-switch thrown — the <2% overhead contract of
+        # eg_telemetry (PERF.md "Telemetry overhead"). The config key
+        # is process-global, so the client AND the in-process shards
+        # all stop recording; re-enabled in the finally below.
+        tel_off = bench_config(
+            reg, steps, batch, fanouts, feature_dim, "telemetry_off",
+            telemetry=False,
+        )
+        telemetry_overhead_pct = round(
+            (tel_off["edges_per_sec"] - after["edges_per_sec"])
+            / tel_off["edges_per_sec"] * 100.0, 2,
+        ) if tel_off["edges_per_sec"] > 0 else 0.0
         reduction = (
             after["ids_requested"] / after["ids_on_wire"]
             if after["ids_on_wire"] > 0 else float("inf")
@@ -282,6 +296,8 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
                 },
                 "before": before,
                 "after": after,
+                "telemetry_off": tel_off,
+                "telemetry_overhead_pct": telemetry_overhead_pct,
                 "speedup": round(
                     after["edges_per_sec"] / before["edges_per_sec"], 3
                 ),
@@ -289,6 +305,9 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
             },
         }
     finally:
+        from euler_tpu.telemetry import set_telemetry
+
+        set_telemetry(True)  # the kill-switch A/B is process-global
         for p in procs:
             if hasattr(p, "stop"):
                 p.stop()

@@ -47,6 +47,16 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_fault_injection.py tests/test_chaos_soak.py -q \
   -p no:cacheprovider || fail=1
 
+step "telemetry suite + cluster scrape smoke (OBSERVABILITY.md)"
+# Histograms/trace spans/STATS scrape: the deterministic-bucket and
+# scrape-parity pins, then a real metrics_dump scrape against a live
+# 2-shard cluster — a silent telemetry regression fails verify before
+# any perf PR cites its numbers.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_telemetry.py -q -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/metrics_dump.py --smoke >/dev/null || fail=1
+
 step "rolling-restart drill + connection storm + wire fuzz (DEPLOY.md runbook)"
 # Server-side survivability: SIGTERM-drain/restart of every shard
 # mid-training with zero failed calls, BUSY load-shedding under a

@@ -46,11 +46,16 @@ RESTARTS = {6: 0, 16: 1}
 
 @pytest.fixture(autouse=True)
 def _clean_slate():
+    from euler_tpu.telemetry import set_telemetry, telemetry_reset
+
     native.fault_clear()
     native.reset_counters()
+    telemetry_reset()
+    set_telemetry(True)
     yield
     native.fault_clear()
     native.reset_counters()
+    telemetry_reset()
 
 
 def _launch_shard(idx: int, data: str, reg: str) -> subprocess.Popen:
@@ -252,6 +257,29 @@ def test_connection_storm_sheds_busy_and_every_call_completes(tmp_path):
         # ...but shedding cost nobody their answer
         assert ctr["calls_failed"] == 0, ctr
         assert ctr["rpc_errors"] == 0, ctr
+
+        # the same verdict must be reachable REMOTELY: scrape the live
+        # server over the STATS opcode (eg_telemetry) and assert the
+        # shedding + admission state off the wire, the way a cluster
+        # operator would — not just via this process's counters
+        import euler_tpu
+
+        native.fault_clear()  # the scrape itself must not stall
+        g = Graph(mode="remote", shards=[addr], retries=4,
+                  timeout_ms=5000)
+        try:
+            scraped = euler_tpu.scrape(g, 0)
+        finally:
+            g.close()
+        assert scraped["counters"]["busy_rejects"] == ctr["busy_rejects"]
+        gauges = scraped["gauges"]
+        assert gauges["workers"] == 2, gauges
+        assert gauges["draining"] == 0, gauges
+        assert 0 <= gauges["queue_depth"], gauges
+        # the storm left latency evidence: the server handler histogram
+        # saw every node_type dispatch the clients measured
+        served = scraped["hist"]["server_handler:node_type"]["count"]
+        assert served >= n_clients * 3, served
         # handler latency stayed bounded: the wait lives in the
         # admission queue, never inside a dispatch (p99==max here)
         span = native.stats().get("service_request")

@@ -149,3 +149,19 @@ def test_stream_rejected_outside_local_mode(fixture_dir, tmp_path):
                    "--stream", "true", "--graph_mode", "shared",
                    "--registry", str(tmp_path / "reg"),
                    "--model", "graphsage_supervised", "--mode", "train"))
+
+
+def test_metrics_every_writes_jsonl(fixture_dir, tmp_path):
+    """--metrics_every=N appends one telemetry snapshot line per N
+    training steps to the JSONL file (OBSERVABILITY.md emission)."""
+    import json
+
+    mf = str(tmp_path / "metrics.jsonl")
+    assert main(_args(fixture_dir, str(tmp_path / "ck_metrics"),
+                      "--model", "graphsage_supervised", "--mode", "train",
+                      "--num_epochs", "2",
+                      "--metrics_every", "2", "--metrics_file", mf)) == 0
+    lines = [json.loads(x) for x in open(mf)]
+    assert lines, "no metrics emitted"
+    assert all(rec["step"] % 2 == 0 for rec in lines)
+    assert all("counters" in rec and "ops" in rec for rec in lines)

@@ -42,15 +42,16 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 26, files  # all .cc and .h of _native
+    assert len(files) >= 28, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
-    # dispatcher + feature cache), and the server survivability layer
-    # (bounded admission) must be under the gate, not grandfathered
-    # around it
+    # dispatcher + feature cache), the server survivability layer
+    # (bounded admission), and the telemetry subsystem must be under
+    # the gate, not grandfathered around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
         "eg_cache.cc", "eg_cache.h", "eg_admission.cc", "eg_admission.h",
+        "eg_telemetry.cc", "eg_telemetry.h",
     } <= names, names
     violations = []
     for f in files:

@@ -1,0 +1,475 @@
+"""eg_telemetry: latency histograms, wire-propagated trace spans, and
+the STATS cluster scrape (OBSERVABILITY.md).
+
+Everything here is deterministic: PR-2's seeded failpoint delays pin
+exact log2 bucket placement, the span-record C ABI pins the journal's
+eviction order with exact microsecond values, and the scrape is
+compared field-by-field against the in-process dump it must mirror.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import euler_tpu
+from euler_tpu import telemetry as T
+from euler_tpu.graph import native
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.service import GraphService
+from tests.fixture_graph import write_fixture
+
+IDS = np.array([10, 11, 12, 13], dtype=np.int64)
+NODE_TYPE_OP = 5  # eg_wire.h WireOp kNodeType
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    native.fault_clear()
+    native.reset_counters()
+    native.stats_reset()
+    T.telemetry_reset()
+    T.set_telemetry(True)
+    yield
+    native.fault_clear()
+    native.reset_counters()
+    T.telemetry_reset()
+    T.set_telemetry(True)
+    T.set_slow_capacity(32)
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("telemetry_data"))
+    write_fixture(d, num_partitions=2)
+    return d
+
+
+def _graph(svcs, **kw):
+    kw.setdefault("retries", 2)
+    kw.setdefault("timeout_ms", 5000)
+    return Graph(mode="remote", shards=[s.address for s in svcs], **kw)
+
+
+def _wait_spans(pred, timeout=5.0):
+    """Journal snapshot once pred(spans) holds. The server records its
+    span AFTER sending the reply, so a client that just got its answer
+    can race the serving worker's journal write by a few microseconds —
+    deterministic content, asynchronous arrival."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        spans = T.slow_spans()
+        if pred(spans):
+            return spans
+        time.sleep(0.01)
+    return T.slow_spans()
+
+
+# ---------------------------------------------------------------------------
+# bucket arithmetic
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_arithmetic_pins_the_log2_layout():
+    # bucket 0 = [0,1µs); bucket b = [2^(b-1), 2^b)µs; last unbounded
+    assert T.bucket_of(0) == 0
+    assert T.bucket_of(1) == 1
+    assert T.bucket_of(2) == 2
+    assert T.bucket_of(3) == 2
+    assert T.bucket_of(4) == 3
+    assert T.bucket_of(16384) == 15
+    assert T.bucket_of(25_000) == 15      # the 25 ms failpoint bucket
+    assert T.bucket_of(32_767) == 15
+    assert T.bucket_of(32_768) == 16
+    assert T.bucket_of(60_000_000) == 26  # 60 s inside the fixed range
+    assert T.bucket_of(1 << 26) == T.NUM_BUCKETS - 1  # overflow bucket
+    assert T.bucket_of(1 << 40) == T.NUM_BUCKETS - 1
+    edges = T.bucket_edges_us()
+    assert len(edges) == T.NUM_BUCKETS - 1
+    assert edges[0] == 1 and edges[-1] == 1 << 26
+
+
+def test_percentiles_interpolate_within_buckets():
+    hist = {"b": [0] * T.NUM_BUCKETS, "count": 0, "sum_us": 0}
+    hist["b"][15] = 100  # all samples in [16384, 32768)
+    pct = T.percentiles(hist, (50, 99))
+    assert 16384 <= pct[50] <= 32768
+    assert pct[50] < pct[99] <= 32768
+    assert T.percentiles({"b": [0] * T.NUM_BUCKETS}, (50,)) == {}
+
+
+# ---------------------------------------------------------------------------
+# deterministic bucket placement + cross-process trace correlation
+# (the ISSUE's acceptance drill)
+# ---------------------------------------------------------------------------
+
+
+def test_failpoint_delay_lands_exact_bucket_and_trace_matches(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = _graph([svc])
+        try:
+            # every dispatch stalls 25 ms in the worker pre-dispatch;
+            # wire + engine cost on loopback stays far under the
+            # bucket's 7.7 ms of headroom, so both sides must land in
+            # bucket 15 = [16384, 32768) µs
+            native.fault_config("handler_stall:delay@25", 7)
+            T.telemetry_reset()
+            t = g.node_types(IDS)
+            np.testing.assert_array_equal(t, [0, 1, 0, 1])
+            native.fault_clear()
+
+            data = T.telemetry_json()
+            server = data["hist"]["server_handler:node_type"]
+            client = data["hist"]["client_call:node_type"]
+            assert sum(server["b"]) == 1
+            assert server["b"][15] == 1, server["b"]
+            assert sum(client["b"]) == 1
+            assert client["b"][15] == 1, client["b"]
+
+            # the SAME request in both journals, correlated by the v3
+            # wire-propagated trace id
+            spans = _wait_spans(lambda ss: any(
+                s["side"] == "server" and s["op"] == "node_type"
+                for s in ss))
+            cli = [s for s in spans
+                   if s["side"] == "client" and s["op"] == "node_type"]
+            srv = [s for s in spans
+                   if s["side"] == "server" and s["op"] == "node_type"]
+            assert len(cli) == 1 and len(srv) == 1, spans
+            assert cli[0]["trace"] != 0
+            assert cli[0]["trace"] == srv[0]["trace"]
+            assert srv[0]["handler_us"] >= 25_000
+            assert cli[0]["total_us"] >= srv[0]["handler_us"]
+            assert cli[0]["shard"] == 0 and srv[0]["shard"] == 0
+            assert cli[0]["outcome"] == "ok" == srv[0]["outcome"]
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# histogram-count == ledger cross-checks
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_counts_match_call_and_dispatch_ledgers(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = _graph([svc])
+        try:
+            native.stats_reset()
+            T.telemetry_reset()
+            n_calls = 7
+            for _ in range(n_calls):
+                g.node_types(IDS)
+            data = T.telemetry_json()
+            # client: one histogram sample per ConnPool::Call — a
+            # single-shard node_types() is exactly one call
+            client = data["hist"]["client_call:node_type"]
+            assert sum(client["b"]) == n_calls == client["count"]
+            # server: Σ handler samples across ALL ops == the span
+            # timer's service_request count (two independent recording
+            # mechanisms, one dispatch each)
+            served = sum(
+                h["count"] for key, h in data["hist"].items()
+                if key.startswith("server_handler:")
+            )
+            assert served == native.stats()["service_request"]["count"]
+            # sums are µs-coherent: mean must sit inside the bucket span
+            assert client["sum_us"] >= sum(client["b"]) * 0
+            pct = T.percentiles(client)
+            assert pct[50] <= pct[99]
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# STATS scrape vs in-process parity (live 2-shard cluster)
+# ---------------------------------------------------------------------------
+
+
+def test_stats_scrape_matches_in_process_dump(data_dir):
+    svcs = [GraphService(data_dir, s, 2) for s in range(2)]
+    try:
+        g = _graph(svcs)
+        try:
+            T.telemetry_reset()
+            native.reset_counters()
+            for _ in range(5):
+                g.node_types(IDS)
+                g.sample_neighbor(IDS, [0, 1], 3)
+            for s in range(2):
+                scraped = euler_tpu.scrape(g, s)
+                # in-process shards: the scrape travels the real wire
+                # but reads the same process globals — counters must be
+                # byte-identical to the local snapshot
+                assert scraped["counters"] == native.counters()
+                assert scraped["shard"] == s
+                gauges = scraped["gauges"]
+                assert gauges["workers"] >= 1
+                assert gauges["draining"] == 0
+                assert gauges["conns"] >= 1  # the scraping conn itself
+                # histogram parity on a family the scrape cannot touch
+                # (its own stats-op sample lands after the reply was
+                # built): any already-recorded op compares exactly
+                local = T.telemetry_json()["hist"]
+                for key in ("server_handler:node_type",
+                            "client_call:sample_neighbor"):
+                    assert scraped["hist"][key]["b"] == local[key]["b"], key
+            # euler_tpu.slow_spans(graph, shard) drains the same journal
+            remote_spans = euler_tpu.slow_spans(g, 0)
+            assert remote_spans
+            assert remote_spans[0]["total_us"] >= remote_spans[-1][
+                "total_us"]
+        finally:
+            g.close()
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-version trace-id downgrade (both directions)
+# ---------------------------------------------------------------------------
+
+
+def test_new_client_against_v1_server_downgrades_trace(data_dir):
+    # wire_version=1 service: answers envelopes with the stock
+    # pre-envelope unknown-op error -> client pins v1, no trace rides
+    svc = GraphService(data_dir, 0, 1, options="wire_version=1")
+    try:
+        native.reset_counters()
+        g = _graph([svc])
+        try:
+            T.telemetry_reset()
+            np.testing.assert_array_equal(g.node_types(IDS), [0, 1, 0, 1])
+            assert native.counters()["wire_downgrades"] == 1
+            spans = _wait_spans(
+                lambda ss: any(s["side"] == "server" for s in ss))
+            srv = [s for s in spans if s["side"] == "server"]
+            cli = [s for s in spans if s["side"] == "client"]
+            # the client still journals with its own trace ids...
+            assert cli and all(s["trace"] != 0 for s in cli)
+            # ...but a v1 peer cannot receive them
+            assert srv and all(s["trace"] == 0 for s in srv)
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+def test_v1_client_against_new_server_serves_without_trace(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = _graph([svc], wire_version=1)
+        try:
+            T.telemetry_reset()
+            np.testing.assert_array_equal(g.node_types(IDS), [0, 1, 0, 1])
+            spans = _wait_spans(
+                lambda ss: any(s["side"] == "server" for s in ss))
+            srv = [s for s in spans if s["side"] == "server"]
+            assert srv and all(s["trace"] == 0 for s in srv)
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+def test_v2_server_pins_deadline_envelope_without_trace(data_dir):
+    # wire_version=2 service: a pre-telemetry build — refuses the v3
+    # trace envelope with kStatusBadVersion; the client must pin v2 on
+    # the same connection (one downgrade, zero retries, exact answers)
+    svc = GraphService(data_dir, 0, 1, options="wire_version=2")
+    try:
+        native.reset_counters()
+        g = _graph([svc])
+        try:
+            T.telemetry_reset()
+            np.testing.assert_array_equal(g.node_types(IDS), [0, 1, 0, 1])
+            ctr = native.counters()
+            assert ctr["wire_downgrades"] == 1, ctr
+            assert ctr["retries"] == 0, ctr
+            assert ctr["calls_failed"] == 0, ctr
+            spans = _wait_spans(
+                lambda ss: any(s["side"] == "server" for s in ss))
+            srv = [s for s in spans if s["side"] == "server"]
+            assert srv and all(s["trace"] == 0 for s in srv)
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# slow-span ring journal
+# ---------------------------------------------------------------------------
+
+
+def test_ring_journal_keeps_slowest_and_evicts_fastest():
+    T.set_slow_capacity(3)
+    T.telemetry_reset()
+    for us in (10, 50, 30, 5, 100, 40):
+        T.record_span(us, op=NODE_TYPE_OP, trace=us)
+    spans = T.slow_spans()
+    # capacity 3: {10,50,30} filled, 5 rejected under the floor, 100
+    # evicts 10, 40 evicts 30 — slowest-first order pins the eviction
+    assert [s["total_us"] for s in spans] == [100, 50, 40], spans
+    assert [s["trace"] for s in spans] == [100, 50, 40]
+    # shrinking capacity keeps the slowest survivors
+    T.set_slow_capacity(2)
+    assert [s["total_us"] for s in T.slow_spans()] == [100, 50]
+
+
+# ---------------------------------------------------------------------------
+# telemetry=0 kill-switch
+# ---------------------------------------------------------------------------
+
+
+def test_kill_switch_records_nothing(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        # the config key rides the graph string and flips the
+        # process-global switch before any call
+        g = _graph([svc], telemetry=False)
+        try:
+            assert not T.telemetry_enabled()
+            for _ in range(4):
+                g.node_types(IDS)
+            data = T.telemetry_json()
+            assert all(h["count"] == 0 for h in data["hist"].values())
+            assert data["slow_spans"] == []
+            assert data["enabled"] == 0
+            # counters and span timers predate the subsystem and must
+            # keep working under the kill-switch
+            assert native.stats()["service_request"]["count"] >= 4
+        finally:
+            g.close()
+        T.set_telemetry(True)
+        g = _graph([svc])
+        try:
+            g.node_types(IDS)
+            data = T.telemetry_json()
+            assert data["hist"]["client_call:node_type"]["count"] == 1
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+def test_telemetry_keys_rejected_on_local_graphs(data_dir):
+    with pytest.raises(ValueError, match="telemetry"):
+        Graph(directory=data_dir, telemetry=False)
+    with pytest.raises(ValueError, match="slow_spans"):
+        Graph(directory=data_dir, slow_spans=8)
+
+
+def test_slow_spans_config_key_resizes_journal(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = _graph([svc], slow_spans=2)
+        try:
+            T.telemetry_reset()
+            for _ in range(6):
+                g.node_types(IDS)
+            # 6 calls -> 12 candidate spans (client + server), journal
+            # holds exactly the configured 2
+            assert len(T.slow_spans()) == 2
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition + JSONL emission
+# ---------------------------------------------------------------------------
+
+ALL_OPS = [
+    "ping", "info", "sample_node", "sample_edge", "node_type",
+    "sample_neighbor", "full_neighbor", "topk_neighbor", "dense_feature",
+    "edge_dense_feature", "sparse_feature", "edge_sparse_feature",
+    "binary_feature", "edge_binary_feature", "node_weight",
+    "sample_neighbor_uniq", "stats",
+]
+
+
+def _parse_exposition(text: str) -> dict:
+    """Minimal Prometheus text parser: {series_line: value}; raises on
+    malformed lines — the validity check."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            if line.startswith("#"):
+                parts = line.split()
+                assert parts[0] == "#" and parts[1] in ("HELP", "TYPE"), line
+            continue
+        series, value = line.rsplit(" ", 1)
+        float(value)  # must parse
+        assert series.count("{") <= 1, line
+        out[series] = float(value)
+    return out
+
+
+def test_metrics_text_is_valid_and_covers_every_op(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = _graph([svc])
+        try:
+            T.telemetry_reset()
+            g.node_types(IDS)
+            text = euler_tpu.metrics_text()
+            series = _parse_exposition(text)
+            # every RPC op appears in BOTH per-op histogram families,
+            # traffic or not
+            for op in ALL_OPS:
+                for fam in ("eg_client_call_latency_us",
+                            "eg_server_handler_latency_us"):
+                    key = f'{fam}_count{{op="{op}"}}'
+                    assert key in series, key
+            assert series['eg_client_call_latency_us_count{op="node_type"}'] == 1
+            # histogram buckets are cumulative and end at +Inf == count
+            inf = 'eg_client_call_latency_us_bucket{op="node_type",le="+Inf"}'
+            assert series[inf] == 1
+            # counters + scalar families present
+            assert 'eg_counter_total{name="retries"}' in series
+            assert "eg_dial_latency_us_count" in series
+            # the per-shard form labels every series with its shard
+            sharded = euler_tpu.metrics_text(graph=g)
+            s_series = _parse_exposition(sharded)
+            key = ('eg_server_handler_latency_us_count'
+                   '{shard="0",op="node_type"}')
+            assert key in s_series, list(s_series)[:5]
+            assert 'eg_workers{shard="0"}' in s_series
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+
+def test_snapshot_jsonl_emitter(tmp_path, data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = _graph([svc])
+        try:
+            T.telemetry_reset()
+            native.reset_counters()
+            g.node_types(IDS)
+            path = str(tmp_path / "metrics.jsonl")
+            T.append_metrics_line(path, step=10)
+            g.node_types(IDS)
+            T.append_metrics_line(path, step=20)
+            lines = [json.loads(x) for x in open(path)]
+            assert [x["step"] for x in lines] == [10, 20]
+            assert lines[0]["ops"]["node_type"]["count"] == 1
+            assert lines[1]["ops"]["node_type"]["count"] == 2
+            assert lines[1]["ops"]["node_type"]["p99_us"] > 0
+            assert "counters" in lines[0]
+        finally:
+            g.close()
+    finally:
+        svc.stop()
