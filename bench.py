@@ -131,6 +131,17 @@ CONFIGS = {
         batch=1000, fanouts=(4, 4), dim=64, lr=0.03,
         warmup=3, measure=15, powerlaw=True, alias_sampling=True,
     ),
+    # Tiny host-path-only config for the perf-regression gate
+    # (scripts/perf_gate.py; verify.sh): small enough to finish in a
+    # couple of minutes on CPU, big enough that the sampling + compute
+    # pipeline is real. host_only skips the device-sampling /
+    # kernel-A/B sections. Not comparable to the full configs above —
+    # the gate compares smoke-to-smoke across rounds.
+    "smoke": dict(
+        num_nodes=3000, avg_degree=8, feature_dim=16, label_dim=4,
+        multilabel=True, batch=128, fanouts=(5, 5), dim=32, lr=0.01,
+        warmup=2, measure=8, host_only=True,
+    ),
     # The sharded REMOTE path (scripts/remote_bench.py): edges/s of a
     # 2-hop fanout + feature batch against a local 2-shard cluster,
     # before/after the dedup + cache + dispatcher optimizations, with
@@ -565,6 +576,8 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
     # framework's intended fast path for graphs that fit in HBM; the
     # host-path numbers above remain in the breakdown for comparison.
     ds = {}
+    if cfg.get("host_only"):
+        return _mk_result(ds)
     try:
         model_ds = SupervisedGraphSage(
             label_idx=0,
@@ -680,6 +693,7 @@ def run_config(name: str, cfg: dict, trace_dir: str | None, bank=None):
 # config can never eat the following configs' window. heavytail gets
 # headroom for the 1.37 GB alias-table upload through the tunnel.
 CONFIG_CAPS = {
+    "smoke": 300.0,
     "ppi": 900.0,
     "reddit": 900.0,
     "reddit_bf16": 900.0,
@@ -821,6 +835,12 @@ def main() -> None:
         "(the driver's no-flag run then covers it for free; an absent "
         "or stale cache is never rebuilt implicitly)" % sorted(CONFIGS),
     )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="run only the tiny host-path 'smoke' config (the "
+        "scripts/perf_gate.py regression probe; smoke-to-smoke "
+        "comparable across rounds, NOT comparable to the full configs)",
+    )
     ap.add_argument("--probe-attempts", type=int,
                     default=int(os.environ.get("EULER_TPU_PROBE_ATTEMPTS", 3)))
     ap.add_argument("--probe-timeout", type=float,
@@ -844,6 +864,8 @@ def main() -> None:
 
     # None = not passed (take defaults); an explicit empty string stays
     # an explicit request to run nothing
+    if args.smoke and args.configs is None:
+        args.configs = "smoke"
     configs = (
         args.configs if args.configs is not None else default_configs()
     )

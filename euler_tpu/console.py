@@ -60,10 +60,11 @@ COMMANDS = {
     ),
     "stats": (
         "Show native stats: span timers + counters; 'hist' for latency "
-        "histograms (p50/p90/p99 per op), 'slow' for the slow-span "
-        "journal, 'reset' to zero everything",
-        "stats [hist|slow|reset]",
-        "stats hist",
+        "histograms (p50/p90/p99 per op), 'phases' for the step-phase "
+        "profiler (input_stall/sample/h2d/device + prefetch gauges), "
+        "'slow' for the slow-span journal, 'reset' to zero everything",
+        "stats [hist|phases|slow|reset]",
+        "stats phases",
     ),
     "quit": ("Exit the console", "quit", "quit"),
 }
@@ -280,6 +281,47 @@ class Console:
             for key, count, pct in rows:
                 print(f"{key:36s} {count:8d} {pct[50]:10.1f} "
                       f"{pct[90]:10.1f} {pct[99]:10.1f}")
+            return
+        if args and args[0] == "phases":
+            # step-phase profiler (OBSERVABILITY.md "Step phases"):
+            # per-phase latency percentiles + the prefetch pipeline's
+            # depth/busy means and produced/dropped/error counters
+            from euler_tpu.telemetry import (
+                PHASES,
+                percentiles,
+                phase_hists,
+                telemetry_json,
+            )
+
+            data = telemetry_json()
+            hists = phase_hists(data)
+            rows = [
+                (name, hists[name])
+                for name in PHASES
+                if hists.get(name, {}).get("count", 0) > 0
+            ]
+            if not rows:
+                print("no step phases recorded (run a training step "
+                      "with telemetry on)")
+                return
+            print(f"{'phase':12s} {'count':>8s} {'mean_ms':>9s} "
+                  f"{'p50_us':>10s} {'p90_us':>10s} {'p99_us':>10s}")
+            for name, h in rows:
+                pct = percentiles(h)
+                mean_ms = h["sum_us"] / h["count"] / 1000.0
+                print(f"{name:12s} {h['count']:8d} {mean_ms:9.2f} "
+                      f"{pct[50]:10.1f} {pct[90]:10.1f} {pct[99]:10.1f}")
+            for key, label in (("prefetch_depth", "queue depth"),
+                               ("prefetch_busy", "workers busy")):
+                h = data["hist"].get(key)
+                if h and h["count"]:
+                    print(f"prefetch {label}: mean "
+                          f"{h['sum_us'] / h['count']:.2f} over "
+                          f"{h['count']} dequeues")
+            pf = {k: v for k, v in counters().items()
+                  if k.startswith("prefetch_") and v}
+            if pf:
+                print(f"prefetch counters: {pf}")
             return
         if args and args[0] == "slow":
             from euler_tpu.telemetry import slow_spans

@@ -14,6 +14,7 @@
 
 #include "eg_engine.h"
 #include "eg_fault.h"
+#include "eg_phase.h"
 #include "eg_registry.h"
 #include "eg_sampling.h"
 #include "eg_stats.h"
@@ -636,6 +637,17 @@ void eg_counters_reset() {
   EG_API_GUARD()
 }
 
+// Bump one counter from Python (the prefetch pipeline runs in Python
+// threads but its ledger must live next to the native transport's so
+// one snapshot/scrape covers both). Out-of-range ids are ignored.
+void eg_counter_add(int i, uint64_t n) {
+  try {
+    if (i >= 0 && i < eg::kCtrCount)
+      eg::Counters::Global().Add(static_cast<eg::CounterId>(i), n);
+  }
+  EG_API_GUARD()
+}
+
 // ---- telemetry (eg_telemetry.h: latency histograms, slow-span
 // journals, the STATS scrape — see OBSERVABILITY.md) ----
 int eg_telemetry_enabled() {
@@ -652,11 +664,33 @@ void eg_telemetry_set_enabled(int on) {
   EG_API_GUARD()
 }
 
-// Zero histograms + the slow-span journal (enabled flag and journal
-// capacity survive — this is the clean-slate primitive tests use).
+// Zero histograms (latency AND step-phase) + the slow-span journal
+// (enabled flag and journal capacity survive — this is the clean-slate
+// primitive tests use).
 void eg_telemetry_reset() {
   try {
     eg::Telemetry::Global().Reset();
+    eg::PhaseStats::Global().Reset();
+  }
+  EG_API_GUARD()
+}
+
+// ---- step-phase profiler (eg_phase.h; OBSERVABILITY.md "Step
+// phases") ----
+// One µs sample for phase `phase` (eg::StepPhase order, mirrored by
+// euler_tpu/telemetry.py PHASES). Honors the telemetry kill-switch.
+void eg_phase_record(int phase, uint64_t us) {
+  try {
+    eg::PhaseStats::Global().Record(phase, us);
+  }
+  EG_API_GUARD()
+}
+
+// One dimensionless prefetch-pipeline sample: which 0 = queue depth at
+// dequeue, 1 = workers busy at dequeue (eg::PrefetchGauge order).
+void eg_phase_gauge(int which, uint64_t value) {
+  try {
+    eg::PhaseStats::Global().RecordGauge(which, value);
   }
   EG_API_GUARD()
 }

@@ -128,6 +128,16 @@ enum CounterId : int {
   kCtrDraining,          // a server entered drain (dereg + finish + close)
   kCtrWireDowngrade,     // a replica negotiated down to wire v1 (old
                          // server detected on its first exchange)
+  // Prefetch pipeline ledger (euler_tpu/parallel/prefetch.py bumps
+  // these through the eg_counter_add ABI): how the training input
+  // pipeline behaved — produced vs dropped batches, and workers that
+  // DIED after init (a dead worker otherwise only surfaces as the
+  // consumer's exception at that step; the counter makes it visible in
+  // any scrape, see OBSERVABILITY.md "Step phases").
+  kCtrPrefetchProduced,     // batches produced by prefetch workers
+  kCtrPrefetchDropped,      // produced batches never consumed (consumer
+                            // abandoned the iterator / error teardown)
+  kCtrPrefetchWorkerError,  // a prefetch worker killed by an exception
   kCtrCount,
 };
 
@@ -138,7 +148,8 @@ const char* const kCounterNames[kCtrCount] = {
     "ids_deduped",        "cache_hits",       "cache_misses",
     "rpc_chunks",         "rpc_errors",       "busy_rejects",
     "busy_failovers",     "handler_timeouts", "deadline_rejects",
-    "draining",           "wire_downgrades",
+    "draining",           "wire_downgrades",  "prefetch_produced",
+    "prefetch_dropped",   "prefetch_worker_errors",
 };
 
 class Counters {

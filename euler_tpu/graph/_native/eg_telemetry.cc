@@ -2,6 +2,7 @@
 
 #include <algorithm>
 
+#include "eg_phase.h"
 #include "eg_stats.h"
 
 namespace eg {
@@ -82,12 +83,14 @@ int Telemetry::slow_capacity() const {
   return span_cap_;
 }
 
-void Telemetry::RecordSpan(const TelemetrySpan& s) {
+void Telemetry::RecordSpan(const TelemetrySpan& span) {
   if (!enabled()) return;
   // Hot-path reject: a full journal only admits spans over its floor.
   if (span_full_.load(std::memory_order_relaxed) &&
-      s.total_us <= span_floor_.load(std::memory_order_relaxed))
+      span.total_us <= span_floor_.load(std::memory_order_relaxed))
     return;
+  TelemetrySpan s = span;
+  if (s.end_us == 0) s.end_us = TelemetryNowUs();
   std::lock_guard<std::mutex> l(span_mu_);
   if (static_cast<int>(spans_.size()) < span_cap_) {
     spans_.push_back(s);
@@ -218,6 +221,10 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
       o.push_back('}');
     }
   }
+  // step-phase + prefetch-gauge histograms (eg_phase.h) join the same
+  // map, so every surface downstream of this dump — metrics_text,
+  // snapshot, the STATS scrape, metrics_dump — sees them for free
+  PhaseStats::Global().HistJsonInto(&o, &first);
   o.push_back('}');
 
   if (g) {
@@ -277,6 +284,9 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
     o.push_back(',');
     AppendKey(&o, "total_us");
     AppendU64(&o, s.total_us);
+    o.push_back(',');
+    AppendKey(&o, "end_us");
+    AppendI64(&o, s.end_us);
     o.push_back(',');
     AppendKey(&o, "outcome");
     o.push_back('"');

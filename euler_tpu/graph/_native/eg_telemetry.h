@@ -109,6 +109,11 @@ struct TelemetrySpan {
   uint64_t handler_us = 0;
   uint64_t wire_us = 0;
   uint64_t total_us = 0;
+  // CLOCK_MONOTONIC µs when the span ENDED (stamped by RecordSpan when
+  // left 0). The machine-wide monotonic epoch is what lets the trace
+  // exporter (euler_tpu/trace.py) place client and shard spans from
+  // different processes on one host onto a single Perfetto timeline.
+  int64_t end_us = 0;
 };
 
 // Admission-layer gauges carried in the kStats scrape reply (the

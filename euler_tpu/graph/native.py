@@ -76,6 +76,9 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_counter_name, c.c_char_p, [c.c_int])
     _sig(L.eg_counters_snapshot, None, [u64p])
     _sig(L.eg_counters_reset, None, [])
+    _sig(L.eg_counter_add, None, [c.c_int, c.c_uint64])
+    _sig(L.eg_phase_record, None, [c.c_int, c.c_uint64])
+    _sig(L.eg_phase_gauge, None, [c.c_int, c.c_uint64])
     _sig(L.eg_telemetry_enabled, c.c_int, [])
     _sig(L.eg_telemetry_set_enabled, None, [c.c_int])
     _sig(L.eg_telemetry_reset, None, [])
@@ -251,6 +254,20 @@ def reset_counters() -> None:
 
 # older spelling, kept so existing callers and muscle memory both work
 counters_reset = reset_counters
+
+_counter_ids: dict = {}
+
+
+def counter_add(name: str, n: int = 1) -> None:
+    """Bump one native counter by name (the prefetch pipeline's Python
+    threads account into the same ledger the native transport uses, so
+    one :func:`counters` snapshot or STATS scrape covers both).
+    Raises KeyError on an unknown counter name."""
+    if not _counter_ids:
+        L = lib()
+        for i in range(L.eg_counter_count()):
+            _counter_ids[L.eg_counter_name(i).decode()] = i
+    lib().eg_counter_add(_counter_ids[name], n)
 
 
 def fault_config(spec: str, seed: int = 0) -> None:

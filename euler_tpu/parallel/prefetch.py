@@ -5,13 +5,52 @@ The TPU replacement for the reference's AsyncOpKernel machinery
 async graph ops inside the step graph, the sampler runs in background
 threads (the native engine releases the GIL) producing batch k+1..k+depth
 while the device computes step k.
+
+Instrumented for the step-phase profiler (OBSERVABILITY.md "Step
+phases"): with ``profile`` on (default: whenever telemetry is enabled)
+the pipeline records
+
+  * ``input_stall`` — consumer wall time blocked on the queue per step
+    (ROADMAP item 1's acceptance metric is this histogram's mean,
+    ``input_stall_ms``);
+  * ``sample`` — per-worker ``make_batch`` produce time (suppress with
+    ``record_sample=False`` when the caller times finer-grained phases
+    inside make_batch itself, as train.py does);
+  * queue-depth and workers-busy value histograms at every dequeue —
+    what tells a starved queue (depth 0, workers busy) apart from
+    slow/dead workers (depth 0, workers idle);
+  * the ``prefetch_produced`` / ``prefetch_dropped`` /
+    ``prefetch_worker_errors`` counters. A worker that dies after init
+    still surfaces as the consumer's exception at its step, but the
+    counter and a journaled error span make it visible in any metrics
+    scrape even when the consumer is mid-step.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator
+
+
+def _profiler():
+    """(record_phase, record_gauges, counter_add) when the telemetry
+    stack is importable and enabled, else None — prefetch() stays
+    usable in processes that never touch the native library."""
+    try:
+        from euler_tpu.graph.native import counter_add
+        from euler_tpu.telemetry import (
+            record_phase,
+            record_prefetch_gauges,
+            telemetry_enabled,
+        )
+
+        if not telemetry_enabled():
+            return None
+        return record_phase, record_prefetch_gauges, counter_add
+    except Exception:
+        return None
 
 
 def prefetch(
@@ -21,6 +60,8 @@ def prefetch(
     num_threads: int = 2,
     start: int = 0,
     worker_init: Callable[[int], None] | None = None,
+    profile: bool | None = None,
+    record_sample: bool = True,
 ) -> Iterator[dict]:
     """Yield num_steps batches for steps start..start+num_steps, produced
     ahead of time by worker threads.
@@ -29,21 +70,40 @@ def prefetch(
     immutable and RNG is thread-local). worker_init(worker_idx) runs once
     at the start of each worker thread — e.g. to seed its thread-local
     sampler RNG for reproducible runs.
+
+    profile=None enables step-phase recording iff telemetry is enabled
+    (the `telemetry=0` kill-switch reaches here too); False forces the
+    zero-instrumentation path.
     """
+    prof = _profiler() if profile in (None, True) else None
     if start:
         base_make = make_batch
         make_batch = lambda step: base_make(step + start)  # noqa: E731
     if num_threads <= 1 or depth <= 0:
+        # Synchronous path: the consumer IS the producer, so every
+        # sample is, by definition, a full consumer stall — exactly the
+        # input_stall the async pipeline above exists to hide.
         if worker_init is not None:
             worker_init(0)
         for step in range(num_steps):
-            yield make_batch(step)
+            t0 = time.perf_counter()
+            batch = make_batch(step)
+            if prof is not None:
+                dur_us = (time.perf_counter() - t0) * 1e6
+                record, gauges, count = prof
+                if record_sample:
+                    record("sample", dur_us, step=step + start)
+                record("input_stall", dur_us, step=step + start)
+                gauges(0, 0)
+                count("prefetch_produced")
+            yield batch
         return
 
     out: "queue.Queue" = queue.Queue()
     cv = threading.Condition()
     next_step = [0]  # next step a worker may claim
     consumed = [0]  # steps the consumer has yielded
+    busy = [0]  # workers currently inside make_batch
     stop = threading.Event()
 
     def worker(widx: int):
@@ -51,6 +111,8 @@ def prefetch(
             if worker_init is not None:
                 worker_init(widx)
         except Exception as e:  # surface init errors instead of hanging
+            if prof is not None:
+                prof[2]("prefetch_worker_errors")
             with cv:
                 # claim the next unclaimed step so the consumer is
                 # guaranteed to reach this error entry
@@ -74,11 +136,37 @@ def prefetch(
                 if stop.is_set() or step >= num_steps:
                     return
                 next_step[0] = step + 1
+                busy[0] += 1
+            t0 = time.perf_counter()
             try:
                 batch = make_batch(step)
             except Exception as e:  # surface errors to the consumer
+                if prof is not None:
+                    # the counter + an error span make the death visible
+                    # in a scrape even while the consumer is mid-step
+                    prof[2]("prefetch_worker_errors")
+                    try:
+                        from euler_tpu.telemetry import record_span
+
+                        record_span(
+                            int((time.perf_counter() - t0) * 1e6),
+                            outcome=1,
+                        )
+                    except Exception:
+                        pass
+                with cv:
+                    busy[0] -= 1
                 out.put((step, e))
                 return
+            if prof is not None:
+                if record_sample:
+                    prof[0](
+                        "sample", (time.perf_counter() - t0) * 1e6,
+                        step=step + start,
+                    )
+                prof[2]("prefetch_produced")
+            with cv:
+                busy[0] -= 1
             out.put((step, batch))
 
     threads = [
@@ -87,14 +175,24 @@ def prefetch(
     ]
     for t in threads:
         t.start()
+    # Reorder: batches may complete out of order with >1 worker. The
+    # pending dict is bounded by depth+1 thanks to the backpressure.
+    pending: dict[int, object] = {}
     try:
-        # Reorder: batches may complete out of order with >1 worker. The
-        # pending dict is bounded by depth+1 thanks to the backpressure.
-        pending: dict[int, object] = {}
         for want in range(num_steps):
+            t_wait = time.perf_counter()
             while want not in pending:
                 step, item = out.get()
                 pending[step] = item
+            if prof is not None:
+                record, gauges, _ = prof
+                record(
+                    "input_stall",
+                    (time.perf_counter() - t_wait) * 1e6,
+                    step=want + start,
+                )
+                # ready batches beyond the one about to be consumed
+                gauges(out.qsize() + len(pending) - 1, busy[0])
             item = pending.pop(want)
             if isinstance(item, Exception):
                 raise item
@@ -108,3 +206,19 @@ def prefetch(
             cv.notify_all()
         for t in threads:
             t.join(timeout=1.0)
+        if prof is not None:
+            # batches produced but never consumed (early close / error
+            # teardown): the pipeline-efficiency side of the ledger
+            dropped = sum(
+                1 for v in pending.values()
+                if not isinstance(v, Exception)
+            )
+            while True:
+                try:
+                    _, item = out.get_nowait()
+                except queue.Empty:
+                    break
+                if not isinstance(item, Exception):
+                    dropped += 1
+            if dropped:
+                prof[2]("prefetch_dropped", dropped)

@@ -203,8 +203,14 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "<model_dir>/metrics.jsonl)"))
     p.add_argument("--telemetry", type=_str2bool, default=True, help=(
         "process-global latency-histogram/slow-span recording "
-        "(eg_telemetry); 0 is the kill-switch — counters and span "
-        "timers keep working either way"))
+        "(eg_telemetry); 0 is the kill-switch — counters, span timers "
+        "AND the step-phase profiler all honor it"))
+    p.add_argument("--trace_file", default="", help=(
+        "write a merged Chrome-trace/Perfetto JSON here when training "
+        "ends: per-step phase slices (input_stall/sample/h2d/device/"
+        "host) + this client's slow-span journal + every live shard's "
+        "scraped journal, flow-linked by wire-v3 trace ids — open in "
+        "ui.perfetto.dev (OBSERVABILITY.md 'Step phases')"))
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
     p.add_argument("--profile_dir", default="")
@@ -659,22 +665,43 @@ def run_train(model, graph, args, mesh):
             if step % args.metrics_every == 0:
                 append_metrics_line(_path, step)
 
-    state, history = train_lib.train(
-        model,
-        graph,
-        source_fn,
-        num_steps=_num_steps(args),
-        optimizer=args.optimizer,
-        learning_rate=args.learning_rate,
-        mesh=mesh,
-        log_every=args.log_steps,
-        seed=args.seed,
-        prefetch_depth=args.prefetch_depth,
-        prefetch_threads=args.prefetch_threads,
-        checkpoint_dir=args.model_dir or None,
-        profile_dir=args.profile_dir or None,
-        step_hook=step_hook,
-    )
+    recorder = None
+    if args.trace_file:
+        from euler_tpu.trace import TraceRecorder
+
+        recorder = TraceRecorder().start()
+    try:
+        state, history = train_lib.train(
+            model,
+            graph,
+            source_fn,
+            num_steps=_num_steps(args),
+            optimizer=args.optimizer,
+            learning_rate=args.learning_rate,
+            mesh=mesh,
+            log_every=args.log_steps,
+            seed=args.seed,
+            prefetch_depth=args.prefetch_depth,
+            prefetch_threads=args.prefetch_threads,
+            checkpoint_dir=args.model_dir or None,
+            profile_dir=args.profile_dir or None,
+            step_hook=step_hook,
+        )
+    finally:
+        if recorder is not None:
+            # export even on an interrupted run — the trace of a run
+            # that died mid-step is exactly the one worth reading
+            recorder.stop()
+            from euler_tpu.trace import write_trace
+
+            os.makedirs(
+                os.path.dirname(args.trace_file) or ".", exist_ok=True
+            )
+            trace = write_trace(args.trace_file, recorder, graph)
+            log.info(
+                "trace: %d events -> %s (open in ui.perfetto.dev)",
+                len(trace["traceEvents"]), args.trace_file,
+            )
     return state, history
 
 

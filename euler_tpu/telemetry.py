@@ -13,9 +13,16 @@ admission gauges. This module is the Python half:
     euler_tpu.scrape(g, shard)          one shard's raw telemetry dict
     euler_tpu.set_telemetry(False)      process-global kill-switch
 
-plus the percentile/bucket arithmetic shared with scripts/
-metrics_dump.py and the --metrics_every JSONL emitter used by run_loop.
-See OBSERVABILITY.md for the metric glossary and scrape runbook.
+plus the step-phase profiler surface (native eg_phase.{h,cc}): the
+training loop and prefetch pipeline record per-step phase timers
+(input_stall / sample / h2d / device / host / step) and prefetch
+pipeline gauges through :func:`record_phase` /
+:func:`record_prefetch_gauges`; they land in the same native "hist" map
+as the RPC latency histograms, so metrics_text(), snapshot(), the STATS
+scrape, and scripts/metrics_dump.py all report them with one renderer
+(OBSERVABILITY.md "Step phases"), and the percentile/bucket arithmetic
+here is shared with scripts/metrics_dump.py and the --metrics_every
+JSONL emitter used by run_loop.
 """
 
 from __future__ import annotations
@@ -29,6 +36,10 @@ from euler_tpu.graph.native import lib
 # Bucket layout — MUST match eg_telemetry.h HistBucketOf: bucket 0 =
 # [0, 1µs); bucket b (1..26) = [2^(b-1), 2^b) µs; bucket 27 = [2^26, inf).
 NUM_BUCKETS = 28
+
+# Step-phase order — MUST match eg_phase.h StepPhase (the profiler
+# records by index through the eg_phase_record ABI, pinned by tests).
+PHASES = ("input_stall", "sample", "h2d", "device", "host", "step")
 
 
 def bucket_of(us: int) -> int:
@@ -136,6 +147,55 @@ def set_slow_capacity(n: int) -> None:
     lib().eg_telemetry_set_slow_capacity(int(n))
 
 
+# ---------------------------------------------------------------------------
+# step-phase profiler (native eg_phase.h; OBSERVABILITY.md "Step phases")
+# ---------------------------------------------------------------------------
+
+# Optional per-event sink the trace recorder (euler_tpu/trace.py)
+# registers: fn(phase, us, step) called on every record_phase while a
+# trace capture is active. None (the default) costs one global read.
+_trace_sink = None
+
+
+def set_trace_sink(fn) -> None:
+    """Install (or clear, with None) the per-event phase sink — the
+    trace recorder's tap into :func:`record_phase`."""
+    global _trace_sink
+    _trace_sink = fn
+
+
+def record_phase(phase: str, us: float, step: int | None = None) -> None:
+    """One step-phase µs sample (train loop / prefetch pipeline call
+    sites). Lands in the ``phase:<name>`` histogram of
+    :func:`telemetry_json` (kill-switch honored natively) and, while a
+    trace capture is active, in the trace recorder's event buffer."""
+    lib().eg_phase_record(PHASES.index(phase), max(int(us), 0))
+    sink = _trace_sink
+    if sink is not None:
+        sink(phase, us, step)
+
+
+def record_prefetch_gauges(queue_depth: int, workers_busy: int) -> None:
+    """One prefetch-pipeline sample at consumer dequeue: ready batches
+    waiting and workers inside make_batch — the two value histograms
+    that tell queue starvation (depth pinned at 0, workers busy) apart
+    from slow/dead workers (depth 0, workers idle)."""
+    L = lib()
+    L.eg_phase_gauge(0, max(int(queue_depth), 0))
+    L.eg_phase_gauge(1, max(int(workers_busy), 0))
+
+
+def phase_hists(data: dict | None = None) -> dict:
+    """{phase: histogram dict} extracted from a telemetry dump
+    (default: this process's)."""
+    data = data or telemetry_json()
+    return {
+        key.partition(":")[2]: h
+        for key, h in data["hist"].items()
+        if key.startswith("phase:")
+    }
+
+
 def record_span(total_us: int, op: int = 0, side: str = "client",
                 outcome: int = 0, shard: int = -1, trace: int = 0,
                 queue_us: int = 0, handler_us: int = 0,
@@ -164,18 +224,30 @@ def slow_spans(graph=None, shard: int | None = None) -> list:
 # Prometheus text exposition
 # ---------------------------------------------------------------------------
 
+# kind -> (family, help, series-label for the per-kind key suffix;
+# scalar kinds have no suffix and ignore the label)
 _HIST_FAMILIES = {
     "client_call": ("eg_client_call_latency_us",
                     "Client whole-call latency per RPC op (retries "
-                    "included), microseconds"),
+                    "included), microseconds", "op"),
     "server_handler": ("eg_server_handler_latency_us",
                        "Server handler time per RPC op (decode + "
-                       "execute + encode), microseconds"),
+                       "execute + encode), microseconds", "op"),
     "server_queue": ("eg_server_queue_wait_us",
-                     "Poller-ready to handler pickup wait, microseconds"),
-    "dial": ("eg_dial_latency_us", "DialTcp latency, microseconds"),
+                     "Poller-ready to handler pickup wait, microseconds",
+                     "op"),
+    "dial": ("eg_dial_latency_us", "DialTcp latency, microseconds", "op"),
     "backoff": ("eg_retry_backoff_us",
-                "Retry backoff sleeps, microseconds"),
+                "Retry backoff sleeps, microseconds", "op"),
+    "phase": ("eg_step_phase_us",
+              "Training step-phase wall time (input_stall/sample/h2d/"
+              "device/host/step), microseconds", "phase"),
+    "prefetch_depth": ("eg_prefetch_queue_depth",
+                       "Ready batches in the prefetch queue at consumer "
+                       "dequeue (value histogram)", "op"),
+    "prefetch_busy": ("eg_prefetch_workers_busy",
+                      "Prefetch workers inside make_batch at consumer "
+                      "dequeue (value histogram)", "op"),
 }
 
 _GAUGE_FAMILIES = {
@@ -201,7 +273,7 @@ def _render(sources: list) -> str:
     lines = []
     edges = bucket_edges_us()
 
-    for kind, (fam, help_text) in _HIST_FAMILIES.items():
+    for kind, (fam, help_text, label) in _HIST_FAMILIES.items():
         lines.append(f"# HELP {fam} {help_text}")
         lines.append(f"# TYPE {fam} histogram")
         for data, base in sources:
@@ -211,7 +283,7 @@ def _render(sources: list) -> str:
                     continue
                 labels = dict(base)
                 if op:
-                    labels["op"] = op
+                    labels[label] = op
                 cum = 0
                 for b, n in enumerate(h["b"]):
                     cum += n
@@ -292,8 +364,10 @@ def metrics_text(graph=None, shard: int | None = None) -> str:
 
 def snapshot(step: int | None = None) -> dict:
     """One compact metrics record for periodic JSONL emission: non-zero
-    counters, per-op client-call count + p50/p99 µs, gauges-free (local
-    process)."""
+    counters, per-op client-call count + p50/p99 µs, step-phase
+    count/p50/p99 per phase plus the headline ``input_stall_ms`` (mean
+    consumer stall per step — ROADMAP item 1's acceptance metric), and
+    prefetch pipeline means. Gauges-free (local process)."""
     data = telemetry_json()
     ops = {}
     for key, h in data["hist"].items():
@@ -306,12 +380,36 @@ def snapshot(step: int | None = None) -> dict:
             "p50_us": round(pct.get(50, 0.0), 1),
             "p99_us": round(pct.get(99, 0.0), 1),
         }
-    return {
+    phases = {}
+    for name, h in phase_hists(data).items():
+        if h["count"] == 0:
+            continue
+        pct = percentiles(h, (50, 99))
+        phases[name] = {
+            "count": h["count"],
+            "p50_us": round(pct.get(50, 0.0), 1),
+            "p99_us": round(pct.get(99, 0.0), 1),
+        }
+    out = {
         "step": step,
         "unix_ms": int(time.time() * 1000),
         "counters": {k: v for k, v in data["counters"].items() if v},
         "ops": ops,
+        "phases": phases,
     }
+    stall = phase_hists(data).get("input_stall")
+    if stall and stall["count"]:
+        out["input_stall_ms"] = round(
+            stall["sum_us"] / stall["count"] / 1000.0, 3
+        )
+    for key, name in (("prefetch_depth", "mean_queue_depth"),
+                      ("prefetch_busy", "mean_workers_busy")):
+        h = data["hist"].get(key)
+        if h and h["count"]:
+            out.setdefault("prefetch", {})[name] = round(
+                h["sum_us"] / h["count"], 2
+            )
+    return out
 
 
 def append_metrics_line(path: str, step: int | None = None) -> None:

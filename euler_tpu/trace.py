@@ -1,0 +1,262 @@
+"""Unified Chrome-trace / Perfetto export for the step-phase profiler.
+
+The histograms (telemetry.py) say how MUCH time each phase takes; this
+module says WHEN — one merged trace where a slow training step can be
+followed from the consumer's ``input_stall`` slice to the prefetch
+worker's ``sample`` slice to the exact shard handler that caused it,
+linked by the PR-5 wire-v3 trace ids.
+
+Three inputs merge into one ``traceEvents`` JSON (the Chrome trace
+format Perfetto and chrome://tracing both open):
+
+  * per-step phase events — :class:`TraceRecorder` taps
+    ``telemetry.record_phase`` while active, so the train loop and
+    prefetch workers need no extra plumbing;
+  * this process's slow-span journal (client side of every RPC);
+  * each live shard's journal via the STATS scrape (server side).
+
+Timeline: CLOCK_MONOTONIC microseconds — ``time.monotonic_ns()//1000``
+in Python, ``std::chrono::steady_clock`` in the native spans
+(``end_us``). The epoch is machine-wide, so phase events and shard
+spans from different PROCESSES on one host line up exactly. Shards on
+other hosts sit at their own clock offset; the trace-id FLOW events
+("s"/"f" pairs) still draw the client-call → server-handler arrows
+regardless of skew.
+
+Surfaces: ``run_loop --trace_file=`` writes the merged trace at the end
+of training; ``scripts/trace_dump.py`` exports from a live cluster (or
+merges into an existing trace file) standalone.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from euler_tpu import telemetry as _telemetry
+
+# Synthetic pids: one "process" lane per source in the merged view.
+PID_TRAIN = 1
+PID_SHARD_BASE = 100  # shard s renders as pid 100+s
+
+
+def now_us() -> int:
+    """CLOCK_MONOTONIC µs — the exporter's one clock (matches the
+    native spans' steady_clock end_us stamps)."""
+    return time.monotonic_ns() // 1000
+
+
+class TraceRecorder:
+    """Bounded in-memory buffer of step-phase events.
+
+    ``start()`` registers the recorder as the telemetry phase sink;
+    every ``record_phase(phase, us, step)`` anywhere in the process
+    (train loop, prefetch consumer, prefetch workers) then lands here
+    with its thread identity, until ``stop()``. The buffer is a ring:
+    beyond ``capacity`` events the oldest fall off (``dropped`` counts
+    them) — a week-long run cannot OOM the trainer."""
+
+    def __init__(self, capacity: int = 200_000):
+        self._events: deque = deque(maxlen=max(int(capacity), 1))
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.started_us: int | None = None
+
+    def start(self) -> "TraceRecorder":
+        self.started_us = now_us()
+        _telemetry.set_trace_sink(self._on_phase)
+        return self
+
+    def stop(self) -> None:
+        if _telemetry._trace_sink is self._on_phase:
+            _telemetry.set_trace_sink(None)
+
+    def _on_phase(self, phase: str, us: float, step: int | None) -> None:
+        end = now_us()
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(
+                (phase, end - max(int(us), 0), int(us), step,
+                 threading.current_thread().name)
+            )
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+
+def _phase_trace_events(phase_events: list) -> list:
+    """Recorder tuples -> complete ("X") slice events on the train pid,
+    one tid lane per recording thread."""
+    out = []
+    tids: dict = {}
+    for phase, ts, dur, step, thread_name in phase_events:
+        tid = tids.setdefault(thread_name, len(tids) + 1)
+        ev = {
+            "name": phase, "cat": "phase", "ph": "X",
+            "ts": ts, "dur": dur, "pid": PID_TRAIN, "tid": tid,
+        }
+        if step is not None:
+            ev["args"] = {"step": step}
+        out.append(ev)
+    for thread_name, tid in tids.items():
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": PID_TRAIN,
+            "tid": tid, "args": {"name": thread_name},
+        })
+    return out
+
+
+def _span_trace_events(data: dict, pid: int, label: str) -> list:
+    """One telemetry dump's slow-span journal -> slice events (client
+    spans on tid 90, server spans on tid 91) carrying the wire-v3 trace
+    id, outcome, and the queue/handler/wire decomposition."""
+    out = []
+    for s in data.get("slow_spans", []):
+        end = int(s.get("end_us", 0))
+        dur = int(s["total_us"])
+        server = s["side"] == "server"
+        out.append({
+            "name": s["op"], "cat": "rpc", "ph": "X",
+            "ts": end - dur, "dur": dur,
+            "pid": pid, "tid": 91 if server else 90,
+            "args": {
+                "trace": f"{int(s['trace']):#x}",
+                "side": s["side"], "outcome": s["outcome"],
+                "shard": s["shard"], "queue_us": s["queue_us"],
+                "handler_us": s["handler_us"], "wire_us": s["wire_us"],
+                "source": label,
+            },
+        })
+    out.append({
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": label},
+    })
+    for tid, name in ((90, "rpc client calls"), (91, "rpc handlers")):
+        out.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name},
+        })
+    return out
+
+
+def _flow_events(span_events: list) -> list:
+    """Client-call -> server-handler flow arrows: for every wire-v3
+    trace id seen on BOTH a client and a server span, emit an
+    "s"/"f" pair so Perfetto links them across process lanes (and
+    across clock skew, when shards live on other hosts)."""
+    by_trace: dict = {}
+    for ev in span_events:
+        args = ev.get("args")
+        if not args or "trace" not in args:
+            continue
+        if int(args["trace"], 16) == 0:
+            continue  # id not propagated (v1/v2 peer / telemetry off)
+        side = args["side"]
+        by_trace.setdefault(args["trace"], {})[side] = ev
+    out = []
+    for trace, sides in by_trace.items():
+        if "client" not in sides or "server" not in sides:
+            continue
+        cli, srv = sides["client"], sides["server"]
+        common = {"name": "rpc", "cat": "rpc-flow", "id": trace}
+        out.append({**common, "ph": "s", "ts": cli["ts"],
+                    "pid": cli["pid"], "tid": cli["tid"]})
+        out.append({**common, "ph": "f", "bp": "e",
+                    "ts": srv["ts"] + srv["dur"],
+                    "pid": srv["pid"], "tid": srv["tid"]})
+    return out
+
+
+def chrome_trace(phase_events: list | None = None,
+                 span_sources: list | None = None,
+                 base_events: list | None = None) -> dict:
+    """Build the merged trace dict.
+
+    phase_events: TraceRecorder tuples (or None);
+    span_sources: [(telemetry dump dict, pid, label), ...];
+    base_events: pre-built traceEvents to merge under (an existing
+    trace file's, in trace_dump.py's merge mode)."""
+    events = list(base_events or [])
+    if phase_events:
+        events.extend(_phase_trace_events(phase_events))
+        events.append({
+            "name": "process_name", "ph": "M", "pid": PID_TRAIN,
+            "args": {"name": "train (step phases)"},
+        })
+    span_events: list = []
+    for data, pid, label in span_sources or []:
+        span_events.extend(_span_trace_events(data, pid, label))
+    events.extend(span_events)
+    events.extend(_flow_events(
+        [e for e in events if e.get("cat") == "rpc"]
+    ))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def gather_span_sources(graph=None) -> list:
+    """This process's journal plus — for a live remote graph — every
+    reachable shard's, as ``chrome_trace`` span_sources. A shard that
+    fails to scrape is skipped (trace export must never fail a training
+    teardown), noted under its label."""
+    sources = [(_telemetry.telemetry_json(), PID_TRAIN,
+                "train (client journal)")]
+    if graph is not None and getattr(graph, "mode", None) == "remote":
+        for s in range(graph.num_shards):
+            try:
+                sources.append((_telemetry.scrape(graph, s),
+                                PID_SHARD_BASE + s, f"shard {s}"))
+            except Exception:
+                pass  # unreachable shard: trace ships without its side
+    return sources
+
+
+def write_trace(path: str, recorder: TraceRecorder | None = None,
+                graph=None, base_events: list | None = None) -> dict:
+    """Export the merged trace to ``path`` and return it."""
+    trace = chrome_trace(
+        recorder.events() if recorder is not None else None,
+        gather_span_sources(graph),
+        base_events,
+    )
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace
+
+
+def validate_chrome_trace(trace: dict) -> list:
+    """Structural validity check (tests + trace_dump --smoke): returns
+    the trace's events after asserting the Chrome-trace invariants the
+    viewers rely on. Raises ValueError on the first violation."""
+    if not isinstance(trace, dict) or "traceEvents" not in trace:
+        raise ValueError("not a Chrome trace: no traceEvents key")
+    events = trace["traceEvents"]
+    if not isinstance(events, list):
+        raise ValueError("traceEvents is not a list")
+    for ev in events:
+        for k in ("name", "ph", "pid"):
+            if k not in ev:
+                raise ValueError(f"event missing {k!r}: {ev}")
+        if ev["ph"] == "X":
+            if "ts" not in ev or "dur" not in ev:
+                raise ValueError(f"X event missing ts/dur: {ev}")
+            if ev["dur"] < 0 or not isinstance(ev["ts"], int):
+                raise ValueError(f"bad X timing: {ev}")
+        if ev["ph"] in ("s", "f") and "id" not in ev:
+            raise ValueError(f"flow event missing id: {ev}")
+    return events
+
+
+def correlated_trace_ids(trace: dict) -> set:
+    """Trace ids carried by BOTH a client and a server rpc slice — the
+    cross-process correlation the acceptance test pins."""
+    sides: dict = {}
+    for ev in trace["traceEvents"]:
+        args = ev.get("args") or {}
+        if ev.get("cat") == "rpc" and "trace" in args:
+            sides.setdefault(args["trace"], set()).add(args["side"])
+    return {t for t, ss in sides.items()
+            if {"client", "server"} <= ss and int(t, 16) != 0}

@@ -88,12 +88,23 @@ def train(
     device_prefetch: bool = True,
     sync_every: Optional[int] = None,
     step_hook=None,
+    phase_profile: Optional[bool] = None,
 ):
     """Train and return (state, history).
 
     step_hook(step) runs on the training thread after every dispatched
     step (run_loop's --metrics_every JSONL emitter rides here; the hook
     gates itself, so the per-step cost is one call + one modulo).
+
+    phase_profile records the step-phase histograms (OBSERVABILITY.md
+    "Step phases"): input_stall + sample inside the prefetch pipeline,
+    h2d (host->device transfer), device (compute, FENCED per step via
+    block_until_ready — attribution needs the fence, so async dispatch
+    no longer runs ahead; host sampling still overlaps through the
+    prefetch workers), host (optimizer/bookkeeping tail), and the
+    whole-step wall. None (default) follows the telemetry kill-switch:
+    profiling on when telemetry is on, and `telemetry=0` restores the
+    fully-async unfenced loop.
 
     source_fn(step) -> int64 root-node batch (fixed size, divisible by the
     mesh size). All sampling runs in the prefetch workers.
@@ -173,6 +184,15 @@ def train(
         donate_argnums=(0,),
     )
 
+    if phase_profile is None:
+        from euler_tpu.telemetry import telemetry_enabled
+
+        try:
+            phase_profile = telemetry_enabled()
+        except Exception:
+            phase_profile = False
+    if phase_profile:
+        from euler_tpu.telemetry import record_phase
     if device_prefetch and cpu_virtual_mesh:
         # XLA's CPU multi-device backend shares one in-process communicator:
         # device_put issued from prefetch worker threads can starve a
@@ -186,8 +206,20 @@ def train(
         # With device_prefetch, device_put runs here inside the prefetch
         # worker, so the host->device copy of batch k+1 overlaps device
         # compute of step k (the copy releases the GIL).
+        t0 = time.perf_counter()
         batch = model.sample(graph, source_fn(step))
-        return shard_batch(batch, mesh) if device_prefetch else batch
+        if not phase_profile:
+            return shard_batch(batch, mesh) if device_prefetch else batch
+        # prefetch applies the start offset before calling: step is
+        # already the absolute step index here
+        t1 = time.perf_counter()
+        record_phase("sample", (t1 - t0) * 1e6, step=step)
+        if device_prefetch:
+            batch = shard_batch(batch, mesh)
+            record_phase(
+                "h2d", (time.perf_counter() - t1) * 1e6, step=step
+            )
+        return batch
 
     name = model.metric_name
     history = []
@@ -230,6 +262,7 @@ def train(
         )
 
     profiling = False
+    t_step = time.perf_counter()
     for batch in prefetch(
         make_batch,
         num_steps - start_step,
@@ -237,13 +270,29 @@ def train(
         prefetch_threads,
         start=start_step,
         worker_init=seed_worker,
+        profile=phase_profile,
+        record_sample=False,  # make_batch above records sample/h2d
     ):
+        # phase brackets (input_stall was recorded inside prefetch):
+        # h2d -> device (fenced) -> host tail; `step` spans body end to
+        # body end so the sum check includes the inter-step stall
+        cur = steps_done  # 0-based step index, matches prefetch labels
         if profile_dir and steps_done - start_step == profile_steps[0]:
             jax.profiler.start_trace(profile_dir)
             profiling = True
         if not device_prefetch:
+            t_h2d = time.perf_counter()
             batch = shard_batch(batch, mesh)
+            if phase_profile:
+                record_phase(
+                    "h2d", (time.perf_counter() - t_h2d) * 1e6, step=cur
+                )
+        t_dev = time.perf_counter()
         state, last_loss, metric = step_fn(state, batch)
+        if phase_profile:
+            jax.block_until_ready(last_loss)
+            t_host = time.perf_counter()
+            record_phase("device", (t_host - t_dev) * 1e6, step=cur)
         window_metrics.append(metric)
         steps_done += 1
         if step_hook is not None:
@@ -259,6 +308,11 @@ def train(
             flush()
         if ckpt and steps_done % checkpoint_every == 0:
             ckpt.save(steps_done, state)
+        if phase_profile:
+            now = time.perf_counter()
+            record_phase("host", (now - t_host) * 1e6, step=cur)
+            record_phase("step", (now - t_step) * 1e6, step=cur)
+            t_step = now
     if window_metrics:  # final partial window
         flush()
     if profiling:

@@ -13,9 +13,18 @@ STATS wire opcode (eg_telemetry), and prints per shard:
   * non-zero counters (FAULTS.md glossary);
   * the shard's slowest spans with their trace ids.
 
+With `--watch N` it re-scrapes every N seconds and prints DELTA columns
+(requests served, counter movement) next to the live gauges — the
+at-a-glance view for watching a rolling restart or a load drill without
+a Prometheus stack. Step-phase histograms (OBSERVABILITY.md "Step
+phases") print whenever a scraped process has recorded any — shard
+services normally haven't (phases live in the training client), but an
+in-process cluster or a future co-located trainer shows them here.
+
 Usage:
     python scripts/metrics_dump.py --registry /shared/reg
     python scripts/metrics_dump.py --shards h1:9001,h2:9001
+    python scripts/metrics_dump.py --registry /shared/reg --watch 5
     python scripts/metrics_dump.py --registry tcp://host:9100 --json
     python scripts/metrics_dump.py --smoke     # self-contained check
                                                # (spins a tiny 2-shard
@@ -31,6 +40,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -69,6 +79,18 @@ def dump_cluster(graph, as_json: bool = False) -> list:
                       f"{pct[90]:10.1f} {pct[99]:10.1f}")
         else:
             print("  no handler latency samples yet")
+        ph_rows = [
+            (key.split(":", 1)[1], h)
+            for key, h in sorted(data["hist"].items())
+            if key.startswith("phase:") and h["count"] > 0
+        ]
+        if ph_rows:
+            print(f"  {'phase':22s} {'count':>8s} {'p50_us':>10s} "
+                  f"{'p90_us':>10s} {'p99_us':>10s}")
+            for ph, h in ph_rows:
+                pct = T.percentiles(h)
+                print(f"  {ph:22s} {h['count']:8d} {pct[50]:10.1f} "
+                      f"{pct[90]:10.1f} {pct[99]:10.1f}")
         nonzero = {k: v for k, v in data["counters"].items() if v}
         if nonzero:
             print(f"  counters: {nonzero}")
@@ -80,6 +102,57 @@ def dump_cluster(graph, as_json: bool = False) -> list:
     if as_json:
         print(json.dumps(shards))
     return shards
+
+
+def _served_total(data: dict) -> int:
+    return sum(
+        h["count"] for key, h in data["hist"].items()
+        if key.startswith("server_handler:")
+    )
+
+
+def watch_cluster(graph, every_s: float, iterations: int | None = None,
+                  out=sys.stdout) -> None:
+    """Re-scrape every `every_s` seconds, printing per-shard DELTAS
+    (requests served, counter movement) next to the live admission
+    gauges. iterations=None runs until interrupted (the CLI); tests
+    pass a bound."""
+    from euler_tpu import telemetry as T
+
+    prev: dict = {}
+    n = 0
+    while iterations is None or n < iterations:
+        if n:
+            time.sleep(every_s)
+        stamp = time.strftime("%H:%M:%S")
+        for s in range(graph.num_shards):
+            try:
+                data = T.scrape(graph, s)
+            except Exception as e:
+                print(f"[{stamp}] shard {s}: scrape failed ({e})",
+                      file=out)
+                continue
+            served = _served_total(data)
+            ctr = {k: v for k, v in data["counters"].items() if v}
+            last = prev.get(s, {})
+            d_served = served - last.get("served", 0)
+            d_ctr = {
+                k: v - last.get("ctr", {}).get(k, 0)
+                for k, v in ctr.items()
+            }
+            d_ctr = {k: v for k, v in d_ctr.items() if v}
+            g = data.get("gauges", {})
+            line = (f"[{stamp}] shard {s}: served +{d_served} "
+                    f"busy {g.get('workers_active', '?')} "
+                    f"queue {g.get('queue_depth', '?')} "
+                    f"conns {g.get('conns', '?')} "
+                    f"draining {g.get('draining', '?')}")
+            if d_ctr:
+                line += f"  Δcounters {d_ctr}"
+            print(line, file=out)
+            prev[s] = {"served": served, "ctr": ctr}
+        out.flush()
+        n += 1
 
 
 def run_smoke() -> int:
@@ -134,6 +207,17 @@ def run_smoke() -> int:
             # client side saw every op too
             spans = T.slow_spans()
             assert spans and any(s["side"] == "client" for s in spans)
+            # the --watch delta path against the same live cluster
+            # (after the parity pins — watching adds scrape traffic):
+            # two iterations with traffic in between must show movement
+            import io
+
+            buf = io.StringIO()
+            watch_cluster(g, 0.05, iterations=1, out=buf)
+            g.sample_node(16, -1)
+            watch_cluster(g, 0.05, iterations=1, out=buf)
+            watch_out = buf.getvalue()
+            assert "served +" in watch_out, watch_out
             print("metrics_dump smoke: OK")
             return 0
         finally:
@@ -153,6 +237,12 @@ def main() -> int:
     ap.add_argument("--timeout_ms", type=int, default=3000)
     ap.add_argument("--json", action="store_true",
                     help="machine-readable: one JSON array of shard dumps")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="N", help=(
+        "re-scrape every N seconds, printing per-shard deltas "
+        "(requests served, counter movement) next to the live gauges; "
+        "Ctrl-C stops"))
+    ap.add_argument("--iterations", type=int, default=None,
+                    help=argparse.SUPPRESS)  # bounds --watch (tests)
     ap.add_argument("--smoke", action="store_true", help=(
         "spin a tiny local 2-shard cluster and assert the scrape "
         "(the verify.sh gate)"))
@@ -174,7 +264,13 @@ def main() -> int:
         rediscover_ms=0,
     )
     try:
-        dump_cluster(g, as_json=args.json)
+        if args.watch > 0:
+            try:
+                watch_cluster(g, args.watch, iterations=args.iterations)
+            except KeyboardInterrupt:
+                pass
+        else:
+            dump_cluster(g, as_json=args.json)
     finally:
         g.close()
     return 0

@@ -171,6 +171,7 @@ def run_workload(graph, steps: int, batch: int, fanouts, feature_dim: int,
     wall s, ids_requested) where ids_requested counts every id a
     pre-dedup client would put on the wire."""
     from euler_tpu.graph import native
+    from euler_tpu.telemetry import record_phase
 
     f1, f2 = fanouts
     edges_per_step = batch * (f1 + f1 * f2)
@@ -178,12 +179,19 @@ def run_workload(graph, steps: int, batch: int, fanouts, feature_dim: int,
     requested = 0
     t0 = time.perf_counter()
     for _ in range(steps):
+        t_step = time.perf_counter()
         roots = graph.sample_node(batch, -1)
         hop_ids, _, _ = graph.sample_fanout(roots, [[0, 1], [0, 1]], [f1, f2])
         requested += batch + batch * f1  # fanout hop inputs
         frontier = np.concatenate(hop_ids)
         graph.get_dense_feature(frontier, [0], [feature_dim])
         requested += len(frontier)
+        # step-phase profiler hooks ride the measured loop so the
+        # telemetry on/off A/B prices them too (the <2% overhead
+        # contract now covers the profiler, not just the RPC histograms)
+        dur_us = (time.perf_counter() - t_step) * 1e6
+        record_phase("sample", dur_us)
+        record_phase("step", dur_us)
     dt = time.perf_counter() - t0
     return edges_per_step * steps / dt, dt, requested
 

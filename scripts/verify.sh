@@ -47,15 +47,19 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_fault_injection.py tests/test_chaos_soak.py -q \
   -p no:cacheprovider || fail=1
 
-step "telemetry suite + cluster scrape smoke (OBSERVABILITY.md)"
-# Histograms/trace spans/STATS scrape: the deterministic-bucket and
-# scrape-parity pins, then a real metrics_dump scrape against a live
-# 2-shard cluster — a silent telemetry regression fails verify before
-# any perf PR cites its numbers.
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m pytest \
-  tests/test_telemetry.py -q -p no:cacheprovider || fail=1
+step "telemetry + step-phase profiler suites + scrape/trace smokes (OBSERVABILITY.md)"
+# Histograms/trace spans/STATS scrape + the step-phase profiler: the
+# deterministic-bucket, stall-attribution, and scrape-parity pins, then
+# a real metrics_dump scrape and a trace_dump Perfetto export against a
+# live 2-shard cluster — a silent telemetry regression fails verify
+# before any perf PR cites its numbers.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_telemetry.py tests/test_phase_profiler.py -q \
+  -p no:cacheprovider || fail=1
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/metrics_dump.py --smoke >/dev/null || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/trace_dump.py --smoke >/dev/null || fail=1
 
 step "rolling-restart drill + connection storm + wire fuzz (DEPLOY.md runbook)"
 # Server-side survivability: SIGTERM-drain/restart of every shard
@@ -65,6 +69,13 @@ step "rolling-restart drill + connection storm + wire fuzz (DEPLOY.md runbook)"
 timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_rolling_restart.py tests/test_wire_fuzz.py -q \
   -p no:cacheprovider || fail=1
+
+step "perf gate (scripts/perf_gate.py — WARN-ONLY, never gates verify)"
+# Smoke-to-smoke throughput trajectory check (PERF.md "Throughput
+# trajectory"): a silent perf regression gets shouted here; run
+# `perf_gate.py --strict` to enforce it.
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
+  python scripts/perf_gate.py || echo "perf_gate: WARN (non-gating)"
 
 step "python syntax floor (compileall)"
 # stdlib floor under the optional tools above: at minimum, every file parses

@@ -32,6 +32,9 @@ COUNTER_NAMES = {
     # timeouts, deadline refusals, drains, wire downgrades
     "busy_rejects", "busy_failovers", "handler_timeouts",
     "deadline_rejects", "draining", "wire_downgrades",
+    # training input pipeline ledger (PR 6): prefetch production/drop
+    # accounting and dead-worker visibility
+    "prefetch_produced", "prefetch_dropped", "prefetch_worker_errors",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",

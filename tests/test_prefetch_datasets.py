@@ -26,6 +26,55 @@ def test_prefetch_worker_error_propagates():
     assert got == [0, 1, 2, 3, 4]
 
 
+def test_prefetch_worker_death_is_scrape_visible():
+    """A worker that dies AFTER init used to surface only as the
+    consumer's exception at that step. It must also bump the
+    prefetch_worker_errors counter and journal an error span, so a dead
+    worker shows up in any metrics scrape even while the consumer is
+    still mid-step (OBSERVABILITY.md 'Step phases')."""
+    from euler_tpu import telemetry as T
+    from euler_tpu.graph import native
+
+    native.reset_counters()
+    T.telemetry_reset()
+    T.set_telemetry(True)
+    try:
+        def make_batch(step):
+            if step == 3:
+                raise RuntimeError("worker died at 3")
+            return step
+
+        with pytest.raises(RuntimeError, match="worker died at 3"):
+            list(prefetch(make_batch, 8, depth=2, num_threads=2))
+        ctr = native.counters()
+        assert ctr["prefetch_worker_errors"] == 1, ctr
+        assert ctr["prefetch_produced"] >= 3
+        spans = T.slow_spans()
+        assert any(s["outcome"] == "error" for s in spans), spans
+        # the counter rides the ordinary exposition too
+        assert ('eg_counter_total{name="prefetch_worker_errors"} 1'
+                in T.metrics_text())
+    finally:
+        native.reset_counters()
+        T.telemetry_reset()
+
+
+def test_prefetch_worker_init_error_counts_too():
+    from euler_tpu.graph import native
+
+    native.reset_counters()
+    try:
+        def bad_init(widx):
+            raise RuntimeError("init blew up")
+
+        with pytest.raises(RuntimeError, match="init blew up"):
+            list(prefetch(lambda s: s, 4, depth=2, num_threads=2,
+                          worker_init=bad_init))
+        assert native.counters()["prefetch_worker_errors"] >= 1
+    finally:
+        native.reset_counters()
+
+
 def test_prefetch_worker_init_error_raises_not_hangs():
     """A failing worker_init must surface to the consumer instead of
     killing every worker silently and blocking forever on the queue."""

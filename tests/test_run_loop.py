@@ -153,15 +153,44 @@ def test_stream_rejected_outside_local_mode(fixture_dir, tmp_path):
 
 def test_metrics_every_writes_jsonl(fixture_dir, tmp_path):
     """--metrics_every=N appends one telemetry snapshot line per N
-    training steps to the JSONL file (OBSERVABILITY.md emission)."""
+    training steps to the JSONL file (OBSERVABILITY.md emission), the
+    snapshots carry the step-phase histograms + input_stall_ms, and
+    --trace_file exports a valid Chrome trace with the phase slices."""
     import json
 
+    from euler_tpu import telemetry as T
+
+    T.telemetry_reset()
     mf = str(tmp_path / "metrics.jsonl")
+    tf = str(tmp_path / "run_trace.json")
     assert main(_args(fixture_dir, str(tmp_path / "ck_metrics"),
                       "--model", "graphsage_supervised", "--mode", "train",
                       "--num_epochs", "2",
-                      "--metrics_every", "2", "--metrics_file", mf)) == 0
+                      "--metrics_every", "2", "--metrics_file", mf,
+                      "--trace_file", tf)) == 0
     lines = [json.loads(x) for x in open(mf)]
     assert lines, "no metrics emitted"
     assert all(rec["step"] % 2 == 0 for rec in lines)
     assert all("counters" in rec and "ops" in rec for rec in lines)
+    # the step-phase profiler reported through the same snapshots
+    last = lines[-1]
+    assert {"input_stall", "sample", "device", "host",
+            "step"} <= set(last["phases"]), last["phases"]
+    assert last["input_stall_ms"] >= 0.0
+    assert last["prefetch"]["mean_queue_depth"] >= 0.0
+    # per-step step-phase counts: every step recorded every loop phase
+    # (the snapshot hook fires mid-body, before that step's host/step
+    # records land — hence the ±1)
+    steps = last["phases"]["step"]["count"]
+    assert steps >= last["step"] - 1
+    assert steps <= last["phases"]["device"]["count"] <= steps + 1
+    # the trace file is a valid Chrome trace whose phase lanes cover
+    # the training loop (h2d rides the prefetch workers here:
+    # device_prefetch on a 1-device CPU mesh stays enabled)
+    from euler_tpu.trace import validate_chrome_trace
+
+    with open(tf) as f:
+        events = validate_chrome_trace(json.load(f))
+    names = {e["name"] for e in events if e.get("cat") == "phase"}
+    assert {"input_stall", "sample", "h2d", "device", "host",
+            "step"} <= names, names

@@ -42,16 +42,16 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 28, files  # all .cc and .h of _native
+    assert len(files) >= 30, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
     # dispatcher + feature cache), the server survivability layer
-    # (bounded admission), and the telemetry subsystem must be under
-    # the gate, not grandfathered around it
+    # (bounded admission), the telemetry subsystem, and the step-phase
+    # profiler must be under the gate, not grandfathered around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
         "eg_cache.cc", "eg_cache.h", "eg_admission.cc", "eg_admission.h",
-        "eg_telemetry.cc", "eg_telemetry.h",
+        "eg_telemetry.cc", "eg_telemetry.h", "eg_phase.cc", "eg_phase.h",
     } <= names, names
     violations = []
     for f in files:
@@ -333,6 +333,40 @@ def test_raw_lock_fires_on_admission_queue_shape():
         "  ready_.push_back(fd);\n"
         "  mu_.unlock();\n"
         "  ready_cv_.notify_one();\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+# ---------------------------------------------------------------------------
+# phase-profiler shapes: the eg_phase ABI + recorder stay under the gate
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_phase_record_shape():
+    """The step-phase ABI is called from Python training threads every
+    step — a guardless eg_phase_record-shaped entry point would carry
+    any native exception straight across ctypes (std::terminate)."""
+    snippet = (
+        'extern "C" {\n'
+        "void eg_phase_record(int phase, uint64_t us) {\n"
+        "  eg::PhaseStats::Global().Record(phase, us);\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_phase_record" in v.message
+
+
+def test_raw_lock_fires_on_phase_snapshot_shape():
+    """A phase-histogram snapshot that raw-locks around its read is the
+    same leak-on-early-return class the journal lock rules pin."""
+    snippet = (
+        "void SnapshotPhases() {\n"
+        "  mu_.lock();\n"
+        "  CopyCells();\n"
+        "  mu_.unlock();\n"
         "}\n"
     )
     violations = only_rule(lint(snippet), "raw-lock")
