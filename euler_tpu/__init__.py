@@ -46,6 +46,11 @@ from euler_tpu.telemetry import (
     telemetry_json,
     telemetry_reset,
 )
+from euler_tpu.blackbox import (
+    blackbox_json,
+    postmortem_read,
+    set_blackbox,
+)
 
 __version__ = "0.2.0"
 
@@ -54,5 +59,6 @@ __all__ = [
     "stats_reset", "counters", "counters_reset", "reset_counters",
     "fault_config", "fault_clear", "fault_injected", "metrics_text",
     "scrape", "set_telemetry", "slow_spans", "telemetry_json",
-    "telemetry_reset",
+    "telemetry_reset", "blackbox_json", "postmortem_read",
+    "set_blackbox",
 ]

@@ -62,8 +62,9 @@ COMMANDS = {
         "Show native stats: span timers + counters; 'hist' for latency "
         "histograms (p50/p90/p99 per op), 'phases' for the step-phase "
         "profiler (input_stall/sample/h2d/device + prefetch gauges), "
-        "'slow' for the slow-span journal, 'reset' to zero everything",
-        "stats [hist|phases|slow|reset]",
+        "'slow' for the slow-span journal, 'blackbox' for the flight "
+        "recorder + resource gauges, 'reset' to zero everything",
+        "stats [hist|phases|slow|blackbox|reset]",
         "stats phases",
     ),
     "quit": ("Exit the console", "quit", "quit"),
@@ -322,6 +323,35 @@ class Console:
                   if k.startswith("prefetch_") and v}
             if pf:
                 print(f"prefetch counters: {pf}")
+            return
+        if args and args[0] == "blackbox":
+            # flight recorder + resource gauges (eg_blackbox,
+            # OBSERVABILITY.md "Postmortems"): the live view of exactly
+            # what a fatal-signal postmortem would freeze
+            from euler_tpu.blackbox import blackbox_json
+
+            d = blackbox_json()
+            r = d["resource"]
+            state = "on" if d["enabled"] else "OFF"
+            print(f"blackbox {state}  shard {d['shard']}  "
+                  f"postmortem_dir {d['postmortem_dir'] or '(unarmed)'}  "
+                  f"dropped {d['dropped']}")
+            print(f"resource: rss {r['rss_bytes'] / 1e6:.1f}MB  "
+                  f"fds {r['open_fds']}  threads {r['threads']}  "
+                  f"cache {r['cache_bytes'] / 1e6:.1f}MB  "
+                  f"history {r['history_depth']}/60 samples")
+            if not d["rings"]:
+                print("flight recorder empty (no instrumented calls yet)")
+                return
+            for ring in d["rings"]:
+                evs = ring["events"]
+                print(f"ring tid={ring['tid']} events={ring['head']} "
+                      f"(showing last {min(len(evs), 8)}):")
+                for e in evs[-8:]:
+                    print(f"  {e['t_us']:>14d}us {e['point']:12s} "
+                          f"op={e['op']:<2d} shard={e['shard']:<3d} "
+                          f"value={e['value']:<8d} "
+                          f"trace={int(e['trace']):#x}")
             return
         if args and args[0] == "slow":
             from euler_tpu.telemetry import slow_spans

@@ -13,6 +13,7 @@
 #include <cstdlib>
 #include <cstring>
 
+#include "eg_blackbox.h"
 #include "eg_fault.h"
 #include "eg_stats.h"
 #include "eg_telemetry.h"
@@ -65,6 +66,12 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
       return false;
     }
     std::string key = item.substr(0, eq);
+    if (key == "postmortem_dir") {
+      // the one string-valued option: where the fatal-signal handler
+      // writes this serving process's dump (eg_blackbox.h)
+      opt->postmortem_dir = item.substr(eq + 1);
+      continue;
+    }
     int v = 0;
     if (!ParseIntOpt(item.substr(eq + 1), &v)) {
       *err = "bad integer in service option '" + item + "'";
@@ -100,12 +107,14 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
         return false;
       }
       opt->slow_spans = v;
+    } else if (key == "blackbox") {
+      opt->blackbox = v != 0 ? 1 : 0;
     } else {
       // loudness rule: a typo'd key must not be dropped silently
       *err = "unknown service option '" + key +
              "' (known: workers, pending, max_conns, io_timeout_ms, "
              "idle_timeout_ms, linger_ms, drain_ms, wire_version, "
-             "telemetry, slow_spans)";
+             "telemetry, slow_spans, blackbox, postmortem_dir)";
       return false;
     }
   }
@@ -122,6 +131,14 @@ bool AdmissionServer::Start(int listen_fd, const AdmissionOptions& opt,
     Telemetry::Global().SetEnabled(opt_.telemetry != 0);
   if (opt_.slow_spans > 0)
     Telemetry::Global().SetSlowCapacity(opt_.slow_spans);
+  // blackbox=/postmortem_dir= options: the server half of the flight-
+  // recorder kill-switch and the fatal-signal dump path (eg_blackbox.h)
+  if (opt_.blackbox >= 0) Blackbox::Global().SetEnabled(opt_.blackbox != 0);
+  if (!opt_.postmortem_dir.empty() &&
+      !Blackbox::Global().Install(opt_.postmortem_dir, opt_.shard_idx)) {
+    *err = Blackbox::Global().error();
+    return false;
+  }
   if (opt_.workers <= 0) {
     unsigned hc = std::thread::hardware_concurrency();
     opt_.workers = 2 * static_cast<int>(hc ? hc : 2);
@@ -292,6 +309,21 @@ void AdmissionServer::PollerLoop() {
       continue;
     }
     int64_t now = NowMs();
+    // Refresh the blackbox's POD gauge snapshot every cycle (<=250 ms
+    // stale): the fatal-signal dump reads THIS, never the live server
+    // object a crashing process may already be tearing down.
+    {
+      AdmissionSnap& snap = AdmissionGaugeSnap();
+      snap.workers.store(opt_.workers, std::memory_order_relaxed);
+      snap.active.store(active_.load(std::memory_order_relaxed),
+                        std::memory_order_relaxed);
+      snap.queue_depth.store(ready_count_.load(std::memory_order_relaxed),
+                             std::memory_order_relaxed);
+      snap.conns.store(conns_.load(std::memory_order_relaxed),
+                       std::memory_order_relaxed);
+      snap.draining.store(draining ? 1 : 0, std::memory_order_relaxed);
+      snap.registered.store(1, std::memory_order_relaxed);
+    }
     size_t k = 0;
     if (pfds[k].revents & POLLIN) {
       char buf[64];
@@ -431,6 +463,13 @@ void AdmissionServer::ServeConn(ReadyConn c) {
     } else {
       if (req.size() > env.body_off)
         op = static_cast<uint8_t>(req[env.body_off]);
+      // flight recorder (eg_blackbox.h): the decoded request — op,
+      // trace id, wire bytes — BEFORE anything can go wrong serving
+      // it, so a handler that dies mid-dispatch leaves the fatal
+      // call's trace id in its ring tail (the postmortem merge keys
+      // the incident timeline on exactly this event)
+      Blackbox::Global().Record(kBbServerRecv, op, opt_.shard_idx,
+                                env.trace_id, req.size(), 0);
       if (opt_.legacy_wire && env.versioned) {
         // v1-server emulation (wire_version=1 option): answer exactly
         // what a pre-envelope build answers, so the client's downgrade
@@ -463,6 +502,11 @@ void AdmissionServer::ServeConn(ReadyConn c) {
           CloseConn(c.fd);
           return;
         }
+        // kFaultCrash at the handler point (FAULTS.md): the server
+        // half of the postmortem drill — Fire raises the configured
+        // fatal signal AFTER the kBbServerRecv record above, so the
+        // dump's ring tail carries the fatal call's trace id.
+        (void)FaultHit(kFaultCrash);
         if (env.deadline_ms >= 0 && NowMs() - ready_ms > env.deadline_ms) {
           // the client's budget is gone: an answer would be dead compute
           ctr.Add(kCtrDeadlineReject);
@@ -513,12 +557,16 @@ void AdmissionServer::ServeConn(ReadyConn c) {
     }
     const int64_t t_send = rec ? TelemetryNowUs() : 0;
     IoStatus ss = SendFrameEx(c.fd, reply);
+    const uint8_t reply_outcome =
+        ss != IoStatus::kOk         ? kOutcomeDropped
+        : status == kStatusOk       ? kOutcomeOk
+        : status == kStatusBusy     ? kOutcomeBusy
+        : status == kStatusDeadline ? kOutcomeDeadline
+                                    : kOutcomeError;
     record_span(rec ? static_cast<uint64_t>(TelemetryNowUs() - t_send) : 0,
-                ss != IoStatus::kOk      ? kOutcomeDropped
-                : status == kStatusOk    ? kOutcomeOk
-                : status == kStatusBusy  ? kOutcomeBusy
-                : status == kStatusDeadline ? kOutcomeDeadline
-                                            : kOutcomeError);
+                reply_outcome);
+    Blackbox::Global().Record(kBbServerReply, op, opt_.shard_idx,
+                              env.trace_id, reply.size(), reply_outcome);
     if (ss != IoStatus::kOk) {
       // kTimeout: the peer stopped reading and the send buffer filled —
       // again the socket timeout frees the slot

@@ -4,6 +4,17 @@
 
 namespace eg {
 
+std::atomic<int64_t>& GlobalCacheBytes() {
+  static std::atomic<int64_t> bytes{0};
+  return bytes;
+}
+
+FeatureCache::~FeatureCache() {
+  for (auto& st : stripes_)
+    GlobalCacheBytes().fetch_sub(static_cast<int64_t>(st.bytes),
+                                 std::memory_order_relaxed);
+}
+
 void FeatureCache::SetCapacity(size_t bytes) {
   cap_ = bytes;
   if (cap_ != 0) return;
@@ -11,6 +22,8 @@ void FeatureCache::SetCapacity(size_t bytes) {
     std::lock_guard<std::mutex> l(st.mu);
     st.map.clear();
     st.fifo.clear();
+    GlobalCacheBytes().fetch_sub(static_cast<int64_t>(st.bytes),
+                                 std::memory_order_relaxed);
     st.bytes = 0;
   }
 }
@@ -67,7 +80,11 @@ void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
     auto victim = st.map.find(st.fifo.front());
     st.fifo.pop_front();
     if (victim == st.map.end()) continue;
-    st.bytes -= victim->second.row.size() * sizeof(float) + kEntryOverhead;
+    size_t freed =
+        victim->second.row.size() * sizeof(float) + kEntryOverhead;
+    st.bytes -= freed;
+    GlobalCacheBytes().fetch_sub(static_cast<int64_t>(freed),
+                                 std::memory_order_relaxed);
     st.map.erase(victim);
   }
   Entry e;
@@ -77,6 +94,8 @@ void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
   st.map.emplace(key, std::move(e));
   st.fifo.push_back(key);
   st.bytes += cost;
+  GlobalCacheBytes().fetch_add(static_cast<int64_t>(cost),
+                               std::memory_order_relaxed);
 }
 
 size_t FeatureCache::bytes() const {

@@ -19,6 +19,7 @@
 #ifndef EG_CACHE_H_
 #define EG_CACHE_H_
 
+#include <atomic>
 #include <cstdint>
 #include <deque>
 #include <mutex>
@@ -27,8 +28,17 @@
 
 namespace eg {
 
+// Process-global resident-byte gauge across every FeatureCache (in
+// practice one per RemoteGraph): stripes add/subtract their deltas so
+// the blackbox resource sampler (eg_blackbox.h) and the fatal-signal
+// dump can read cache pressure with one relaxed load — a postmortem
+// must not walk stripe mutexes.
+std::atomic<int64_t>& GlobalCacheBytes();
+
 class FeatureCache {
  public:
+  ~FeatureCache();  // returns resident bytes to the global gauge
+
   // Total byte budget across stripes; 0 disables (Get misses, Put drops).
   void SetCapacity(size_t bytes);
   bool enabled() const { return cap_ != 0; }

@@ -12,6 +12,7 @@
 #include <string>
 #include <vector>
 
+#include "eg_blackbox.h"
 #include "eg_engine.h"
 #include "eg_fault.h"
 #include "eg_phase.h"
@@ -194,8 +195,8 @@ int eg_remote_strict_error(void* h, char* buf, int cap) {
 // reference euler/service/python_api.cc:26-52) ----
 // `options` is the "k=v;k=v" admission spec (workers/pending/max_conns/
 // io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version/
-// telemetry/slow_spans — see eg_admission.h); NULL/empty = defaults.
-// Unknown keys fail loudly.
+// telemetry/slow_spans/blackbox/postmortem_dir — see eg_admission.h);
+// NULL/empty = defaults. Unknown keys fail loudly.
 void* eg_service_start(const char* data_dir, int shard_idx, int shard_num,
                        const char* host, int port, const char* registry_dir,
                        const char* options) {
@@ -679,9 +680,15 @@ void eg_telemetry_reset() {
 // phases") ----
 // One µs sample for phase `phase` (eg::StepPhase order, mirrored by
 // euler_tpu/telemetry.py PHASES). Honors the telemetry kill-switch.
+// Also lands in the flight recorder (eg_blackbox.h, its own
+// kill-switch): a postmortem of a dead TRAINER shows which step phase
+// it died in, not just which RPCs were in flight.
 void eg_phase_record(int phase, uint64_t us) {
   try {
     eg::PhaseStats::Global().Record(phase, us);
+    eg::Blackbox::Global().Record(eg::kBbPhase,
+                                  static_cast<uint8_t>(phase & 0xFF), -1,
+                                  0, us, 0);
   }
   EG_API_GUARD()
 }
@@ -753,6 +760,130 @@ int eg_remote_scrape(void* h, int shard, char* buf, int cap) {
     std::string js;
     if (!static_cast<RemoteGraph*>(API(h))->ScrapeShard(shard, &js)) {
       g_last_error = "telemetry scrape failed: shard " +
+                     std::to_string(shard) + " unreachable or invalid";
+      return -1;
+    }
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
+}
+
+// ---- blackbox flight recorder + postmortem path (eg_blackbox.h;
+// OBSERVABILITY.md "Postmortems") ----
+int eg_blackbox_enabled() {
+  try {
+    return eg::Blackbox::Global().enabled() ? 1 : 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+void eg_blackbox_set_enabled(int on) {
+  try {
+    eg::Blackbox::Global().SetEnabled(on != 0);
+  }
+  EG_API_GUARD()
+}
+
+// Arm the postmortem path: remember postmortem_dir (empty/NULL = leave
+// the dump destination alone), label dumps with `shard`, install the
+// fatal-signal handlers, start the resource sampler (period sample_ms,
+// 0 = keep current). -1 + eg_last_error when the dir is unwritable.
+int eg_blackbox_init(const char* postmortem_dir, int shard, int sample_ms) {
+  try {
+    if (!eg::Blackbox::Global().Install(
+            postmortem_dir ? postmortem_dir : "", shard, sample_ms)) {
+      g_last_error = eg::Blackbox::Global().error();
+      return -1;
+    }
+    return 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+// One app-level flight-recorder event from Python (the run_loop /
+// prefetch layer accounts into the same rings the native hooks use).
+void eg_blackbox_record(int point, int op, int shard, uint64_t trace,
+                        uint64_t value, int outcome) {
+  try {
+    eg::Blackbox::Global().Record(
+        point >= 0 && point < eg::kBbPointCount
+            ? static_cast<uint8_t>(point)
+            : static_cast<uint8_t>(eg::kBbApp),
+        static_cast<uint8_t>(op & 0xFF), shard, trace, value,
+        static_cast<uint8_t>(outcome & 0xFF));
+  }
+  EG_API_GUARD()
+}
+
+// Live flight-recorder + resource-history dump as JSON. Same buf/cap/
+// return contract as eg_telemetry_json.
+int eg_blackbox_json(char* buf, int cap) {
+  try {
+    std::string js = eg::Blackbox::Global().LiveJson();
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
+}
+
+// Local resource-gauge history (the in-process twin of the kHistory
+// scrape). Same buf/cap/return contract as eg_telemetry_json.
+int eg_blackbox_history(char* buf, int cap) {
+  try {
+    eg::Blackbox& bb = eg::Blackbox::Global();
+    std::string js = bb.HistoryJson(bb.shard());
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
+}
+
+// Write a postmortem dump NOW (the manual path: run_loop's unhandled-
+// exception hook, tests). Same format as the fatal-signal dump with
+// signal 0 ("exception"). -1 when the blackbox is disabled or the path
+// cannot be opened.
+int eg_blackbox_dump(const char* path) {
+  try {
+    if (!path || !eg::Blackbox::Global().WriteDump(path, 0)) {
+      g_last_error = "blackbox dump failed (disabled, or path not "
+                     "writable)";
+      return -1;
+    }
+    return 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+// Zero the flight-recorder rings + drop ledger (enabled flag, handlers
+// and resource history survive) — the clean-slate primitive tests use.
+void eg_blackbox_reset() {
+  try {
+    eg::Blackbox::Global().Reset();
+  }
+  EG_API_GUARD()
+}
+
+// Remote resource-history scrape (kHistory opcode): fetch shard
+// `shard`'s gauge ring. Same buf/cap/return contract as
+// eg_remote_scrape; -1 on transport failure or bad shard index.
+int eg_remote_history(void* h, int shard, char* buf, int cap) {
+  try {
+    std::string js;
+    if (!static_cast<RemoteGraph*>(API(h))->HistoryShard(shard, &js)) {
+      g_last_error = "history scrape failed: shard " +
                      std::to_string(shard) + " unreachable or invalid";
       return -1;
     }

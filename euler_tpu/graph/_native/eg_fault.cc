@@ -1,8 +1,12 @@
 #include "eg_fault.h"
 
+#include <signal.h>
+
 #include <chrono>
 #include <cstdlib>
 #include <thread>
+
+#include "eg_stats.h"
 
 namespace eg {
 
@@ -132,6 +136,17 @@ bool FaultInjector::Fire(FaultId id) {
     ++p.fired;
     delay_ms = p.delay_ms;
     fail = p.err;
+  }
+  if (id == kFaultCrash) {
+    // Postmortem drill (FAULTS.md): the action params pick the signal —
+    // err@p raises SIGSEGV, delay@SIG reuses the ms slot as the signal
+    // number (6 = SIGABRT). The ledger entry lands BEFORE the raise so
+    // the blackbox signal handler's counter snapshot includes this fire
+    // (the client audits the dead shard's postmortem against it).
+    Counters::Global().Add(kCtrCrash);
+    int sig = fail ? SIGSEGV : (delay_ms > 0 ? delay_ms : SIGABRT);
+    ::raise(sig);
+    return true;  // unreachable for fatal dispositions; honest otherwise
   }
   if (delay_ms > 0)
     std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));

@@ -46,6 +46,15 @@ enum FaultId : int {
                        // wedges and abandons the connection (err)
   kFaultBusyForce,     // admission: the capacity check is forced to
                        // report overload — a deterministic BUSY reply
+  // Postmortem-path failpoint (eg_blackbox.h): a seeded FATAL SIGNAL at
+  // the dial (client) and handler (server) hook points, so the
+  // flight-recorder + crash-dump path is deterministically testable.
+  // Grammar reuses the action params as the signal choice:
+  //   crash:err@p[#limit]          raise(SIGSEGV)
+  //   crash:delay@SIG[@p][#limit]  raise(SIG) (e.g. 6 = SIGABRT)
+  // The `crashes` counter is bumped BEFORE the raise, so the signal
+  // handler's postmortem ledger accounts for the fire that killed it.
+  kFaultCrash,
   kFaultIdCount,
 };
 
@@ -54,6 +63,7 @@ const char* const kFaultNames[kFaultIdCount] = {
     "dial",           "send_frame", "recv_frame",
     "service_reply",  "registry_reply", "heartbeat",
     "accept",         "handler_stall",  "busy_force",
+    "crash",
 };
 
 class FaultInjector {

@@ -12,6 +12,7 @@
 #include <thread>
 #include <unordered_map>
 
+#include "eg_blackbox.h"
 #include "eg_fault.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
@@ -166,6 +167,14 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
       sp.total_us = total;
       tel.RecordSpan(sp);
     }
+    // flight recorder (eg_blackbox.h): every finished call — trace id,
+    // shard, and the wire bytes moved — lands in this thread's ring,
+    // so a postmortem shows what the process was asking for when it
+    // died (its own kill-switch; a failed call still records, reply
+    // bytes count only when one arrived)
+    Blackbox::Global().Record(
+        kBbClientCall, op, shard_, trace,
+        req.size() + (ok ? reply->size() : 0), outcome);
     return ok;
   };
   // snapshot: Update() may swap the set mid-call; shared_ptrs keep every
@@ -242,6 +251,11 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
           break;
         }
       }
+      // kFaultCrash at the dial point (FAULTS.md): the client half of
+      // the postmortem drill — Fire raises the configured fatal signal
+      // in THIS process (the blackbox handler dumps, then the default
+      // disposition kills).
+      (void)FaultHit(kFaultCrash);
       int fd = -1;
       {
         std::lock_guard<std::mutex> l(rep->mu);
@@ -704,6 +718,18 @@ bool RemoteGraph::ScrapeShard(int shard, std::string* json) const {
   return r.ok();
 }
 
+bool RemoteGraph::HistoryShard(int shard, std::string* json) const {
+  if (shard < 0 || shard >= num_shards_) return false;
+  WireWriter req;
+  req.U8(kHistory);
+  std::string reply;
+  if (!Call(shard, req.buf(), &reply)) return false;
+  WireReader r(reply);
+  r.U8();  // status already checked in Call
+  *json = r.Str();
+  return r.ok();
+}
+
 std::string RemoteGraph::TakeStrictError() const {
   std::lock_guard<std::mutex> l(strict_mu_);
   std::string out;
@@ -797,6 +823,10 @@ void RemoteGraph::ForShards(const std::vector<std::vector<int32_t>>& rows,
   for (int s = 0; s < static_cast<int>(rows.size()); ++s)
     if (!rows[s].empty())
       jobs.emplace_back([this, &fn, s, what] {
+        // flight recorder: timestamp this worker picking up a shard
+        // job, so a postmortem shows which shards the dispatcher pool
+        // was fanning out to in its final seconds
+        Blackbox::Global().Record(kBbDispatch, 0, s, 0, 0, 0);
         bool ok = false;
         try {
           ok = fn(s);
@@ -824,6 +854,8 @@ void RemoteGraph::RunChunked(
     for (int32_t b = 0; b < m; b += step) {
       int32_t e = std::min(m, b + step);
       jobs.emplace_back([this, &chunk_fn, s, b, e, what] {
+        Blackbox::Global().Record(kBbDispatch, 0, s, 0,
+                                  static_cast<uint64_t>(e - b), 0);
         bool ok = false;
         try {
           ok = chunk_fn(s, b, e);

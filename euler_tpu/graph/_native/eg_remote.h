@@ -183,6 +183,11 @@ class RemoteGraph : public GraphAPI {
   // document Telemetry::Json builds locally, so scrape-vs-local parity
   // is a field compare. False on transport failure / bad shard index.
   bool ScrapeShard(int shard, std::string* json) const;
+  // Resource-gauge history of one live shard (kHistory opcode,
+  // eg_blackbox.h): the shard's background-sampled RSS/fds/threads/
+  // cache ring as JSON — the live twin of a postmortem's frozen
+  // resource_history. False on transport failure / bad shard index.
+  bool HistoryShard(int shard, std::string* json) const;
   // Pending strict-mode failure: copies + clears the first recorded
   // message. Empty string = no pending failure. (The fixed-shape query
   // ABI returns void, so strict failures surface through this side

@@ -9,6 +9,7 @@
 #include <cstdio>
 #include <fstream>
 
+#include "eg_blackbox.h"
 #include "eg_fault.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
@@ -237,6 +238,14 @@ void Service::Dispatch(const char* req, size_t len,
       g.conns = admission_.conns();
       g.draining = admission_.draining() ? 1 : 0;
       w.Str(Telemetry::Global().Json(shard_idx_, &g));
+      break;
+    }
+    case kHistory: {
+      // Resource-gauge history scrape (eg_blackbox.h): the live view of
+      // exactly what a postmortem freezes — RSS/fds/threads/cache over
+      // the last ~minute — so an operator can watch a shard leak before
+      // it dies, not only read about it after.
+      w.Str(Blackbox::Global().HistoryJson(shard_idx_));
       break;
     }
     case kInfo: {

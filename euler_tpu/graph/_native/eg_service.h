@@ -46,8 +46,9 @@ class Service {
   // the TTL entry alive — the ephemeral-znode analog, eg_registry.h).
   // `options` is a "k=v;k=v" admission spec (workers/pending/max_conns/
   // io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version/
-  // telemetry/slow_spans — see eg_admission.h); unknown keys fail
-  // loudly. False + error() on failure.
+  // telemetry/slow_spans/blackbox/postmortem_dir — see
+  // eg_admission.h); unknown keys fail loudly. False + error() on
+  // failure.
   bool Start(const std::string& data_dir, int shard_idx, int shard_num,
              const std::string& host, int port,
              const std::string& registry_dir,

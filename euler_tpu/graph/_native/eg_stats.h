@@ -138,6 +138,12 @@ enum CounterId : int {
   kCtrPrefetchDropped,      // produced batches never consumed (consumer
                             // abandoned the iterator / error teardown)
   kCtrPrefetchWorkerError,  // a prefetch worker killed by an exception
+  // Postmortem ledger (eg_blackbox.h / FAULTS.md): fires of the seeded
+  // `crash` failpoint, bumped BEFORE the signal is raised so the
+  // fatal-signal dump's counter snapshot includes the fire that killed
+  // the process — the exact-arithmetic anchor the blackbox tests audit
+  // a dead shard's postmortem against.
+  kCtrCrash,
   kCtrCount,
 };
 
@@ -149,7 +155,7 @@ const char* const kCounterNames[kCtrCount] = {
     "rpc_chunks",         "rpc_errors",       "busy_rejects",
     "busy_failovers",     "handler_timeouts", "deadline_rejects",
     "draining",           "wire_downgrades",  "prefetch_produced",
-    "prefetch_dropped",   "prefetch_worker_errors",
+    "prefetch_dropped",   "prefetch_worker_errors", "crashes",
 };
 
 class Counters {

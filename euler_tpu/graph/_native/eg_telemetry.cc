@@ -2,6 +2,7 @@
 
 #include <algorithm>
 
+#include "eg_blackbox.h"
 #include "eg_phase.h"
 #include "eg_stats.h"
 
@@ -226,6 +227,13 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
   // snapshot, the STATS scrape, metrics_dump — sees them for free
   PhaseStats::Global().HistJsonInto(&o, &first);
   o.push_back('}');
+
+  // process resource gauges (eg_blackbox.h): RSS / open fds / live
+  // threads / cache bytes — emitted into the same dump every metrics
+  // surface reads, so metrics_text()/snapshot()/the STATS scrape pick
+  // them up with zero new plumbing (and a postmortem's frozen values
+  // can be compared against what the live surfaces showed)
+  Blackbox::Global().ResourceJsonInto(&o);
 
   if (g) {
     o.push_back(',');

@@ -84,6 +84,11 @@ enum WireOp : uint8_t {
   // Reply: [Str json] — the same JSON Telemetry::Json builds for the
   // local surface, so scrape-vs-local parity is one string compare.
   kStats = 17,
+  // Resource-gauge history scrape (eg_blackbox.h): the shard's 60-entry
+  // background-sampled ring of {RSS, open fds, live threads, cache
+  // bytes} plus a fresh sample — the live view of exactly what a
+  // postmortem dump freezes. Request: no args. Reply: [Str json].
+  kHistory = 18,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap

@@ -132,6 +132,8 @@ class Graph:
         wire_version: int | None = None,
         telemetry: bool | None = None,
         slow_spans: int | None = None,
+        blackbox: bool | None = None,
+        postmortem_dir: str | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
         config: str | None = None,
@@ -150,7 +152,8 @@ class Graph:
             "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
             "fault_seed", "feature_cache_mb", "strict", "coalesce",
             "chunk_ids", "dispatch_workers", "wire_version", "telemetry",
-            "slow_spans", "cache_dir", "stream", "init",
+            "slow_spans", "blackbox", "postmortem_dir", "cache_dir",
+            "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -219,6 +222,14 @@ class Graph:
         if isinstance(telemetry, str):
             telemetry = str2bool(telemetry)
         slow_spans = pick("slow_spans", slow_spans, None)
+        # blackbox flight recorder + postmortem dump path
+        # (eg_blackbox.h; process-global like telemetry=, but valid in
+        # BOTH modes — an embedded-engine trainer crashes too, and its
+        # postmortem is exactly as valuable as a shard's)
+        blackbox = pick("blackbox", blackbox, None)
+        if isinstance(blackbox, str):
+            blackbox = str2bool(blackbox)
+        postmortem_dir = pick("postmortem_dir", postmortem_dir, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
@@ -276,6 +287,18 @@ class Graph:
             )
         if init not in ("eager", "lazy"):
             raise ValueError("init must be 'eager' or 'lazy'")
+        # graph init arms the blackbox (the service arms it on its own
+        # side): kill-switch first, then the postmortem path — BEFORE
+        # the engine/remote handle exists, so even a crash during load
+        # or discovery leaves a dump
+        if blackbox is not None:
+            from euler_tpu import blackbox as _blackbox
+
+            _blackbox.set_blackbox(bool(blackbox))
+        if postmortem_dir is not None:
+            from euler_tpu import blackbox as _blackbox
+
+            _blackbox.install(postmortem_dir)
         self._params = dict(
             directory=directory, files=files, shard_idx=shard_idx,
             shard_num=shard_num, registry=registry, shards=shards,

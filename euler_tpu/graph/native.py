@@ -91,6 +91,19 @@ def lib() -> ctypes.CDLL:
          c.c_uint64, c.c_uint64, c.c_uint64],
     )
     _sig(L.eg_remote_scrape, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
+    _sig(L.eg_remote_history, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
+    _sig(L.eg_blackbox_enabled, c.c_int, [])
+    _sig(L.eg_blackbox_set_enabled, None, [c.c_int])
+    _sig(L.eg_blackbox_init, c.c_int, [c.c_char_p, c.c_int, c.c_int])
+    _sig(
+        L.eg_blackbox_record,
+        None,
+        [c.c_int, c.c_int, c.c_int, c.c_uint64, c.c_uint64, c.c_int],
+    )
+    _sig(L.eg_blackbox_json, c.c_int, [c.c_char_p, c.c_int])
+    _sig(L.eg_blackbox_history, c.c_int, [c.c_char_p, c.c_int])
+    _sig(L.eg_blackbox_dump, c.c_int, [c.c_char_p])
+    _sig(L.eg_blackbox_reset, None, [])
     _sig(L.eg_fault_config, c.c_int, [c.c_char_p, c.c_uint64])
     _sig(L.eg_fault_clear, None, [])
     _sig(L.eg_fault_count, c.c_int, [])

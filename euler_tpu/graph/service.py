@@ -43,6 +43,8 @@ class GraphService:
         workers: int | None = None,
         pending: int | None = None,
         options: str | None = None,
+        postmortem_dir: str | None = None,
+        blackbox: bool | None = None,
     ):
         self._lib = lib()
         from euler_tpu.graph import remote_fs
@@ -57,13 +59,22 @@ class GraphService:
             data_dir = remote_fs.strip_local_scheme(data_dir)
         # admission spec (eg_admission.h): the common knobs get kwargs,
         # the long tail (max_conns, io_timeout_ms, idle_timeout_ms,
-        # linger_ms, drain_ms, wire_version, telemetry, slow_spans)
-        # rides in options=
+        # linger_ms, drain_ms, wire_version, telemetry, slow_spans,
+        # blackbox, postmortem_dir) rides in options=
         opts = []
         if workers is not None:
             opts.append(f"workers={int(workers)}")
         if pending is not None:
             opts.append(f"pending={int(pending)}")
+        if blackbox is not None:
+            opts.append(f"blackbox={1 if blackbox else 0}")
+        if postmortem_dir is not None:
+            # the native probe fails loudly on an unwritable dir; create
+            # it here so `postmortem_dir=<fresh tmp path>` just works
+            import os
+
+            os.makedirs(postmortem_dir, exist_ok=True)
+            opts.append(f"postmortem_dir={postmortem_dir}")
         if options:
             opts.append(options)
         self._h = self._lib.eg_service_start(
@@ -138,8 +149,16 @@ def main() -> None:
     ap.add_argument("--options", default=None, help=(
         "extra k=v;k=v admission options (max_conns, io_timeout_ms, "
         "idle_timeout_ms, linger_ms, drain_ms, wire_version, telemetry, "
-        "slow_spans — see "
+        "slow_spans, blackbox, postmortem_dir — see "
         "eg_admission.h)"))
+    ap.add_argument("--postmortem_dir", default=None, help=(
+        "arm the fatal-signal postmortem path: on SIGSEGV/SIGBUS/"
+        "SIGABRT/SIGFPE this shard writes <dir>/postmortem.<pid>.json "
+        "(flight-recorder rings + counters + gauges + backtrace; "
+        "OBSERVABILITY.md 'Postmortems') before dying"))
+    ap.add_argument("--blackbox", type=int, default=None, help=(
+        "flight-recorder kill-switch: 0 disables ring recording AND "
+        "suppresses the postmortem dump (default: on)"))
     ap.add_argument("--fault", default="", help=(
         "deterministic failpoint spec injected in THIS shard process "
         "(service_reply/recv_frame/handler_stall/busy_force/... — see "
@@ -160,6 +179,8 @@ def main() -> None:
         workers=args.workers,
         pending=args.pending,
         options=args.options,
+        postmortem_dir=args.postmortem_dir,
+        blackbox=None if args.blackbox is None else bool(args.blackbox),
     )
     print(
         f"graph shard {svc.shard_idx}/{svc.shard_num} serving on"

@@ -205,6 +205,16 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "process-global latency-histogram/slow-span recording "
         "(eg_telemetry); 0 is the kill-switch — counters, span timers "
         "AND the step-phase profiler all honor it"))
+    p.add_argument("--postmortem_dir", default="", help=(
+        "arm the blackbox postmortem path (eg_blackbox): fatal signals "
+        "(SIGSEGV/SIGBUS/SIGABRT/SIGFPE) AND unhandled Python "
+        "exceptions write <dir>/postmortem.<pid>[.exception].json — "
+        "flight-recorder rings, counters, resource history, backtrace "
+        "— before the process dies; collect a dead cluster's dumps "
+        "with scripts/postmortem.py (OBSERVABILITY.md 'Postmortems')"))
+    p.add_argument("--blackbox", type=_str2bool, default=True, help=(
+        "flight-recorder kill-switch: 0 stops ring recording AND "
+        "suppresses postmortem dumps (counters/telemetry unaffected)"))
     p.add_argument("--trace_file", default="", help=(
         "write a merged Chrome-trace/Perfetto JSON here when training "
         "ends: per-step phase slices (input_stall/sample/h2d/device/"
@@ -820,7 +830,40 @@ def main(argv=None) -> int:
         from euler_tpu.telemetry import set_telemetry
 
         set_telemetry(False)
-    graph, services = build_graph(args)
+    from euler_tpu import blackbox as blackbox_mod
+
+    if not args.blackbox:
+        blackbox_mod.set_blackbox(False)
+    if args.postmortem_dir:
+        # arm BEFORE any graph/service exists, so even a crash during
+        # load or discovery leaves a dump
+        blackbox_mod.install(args.postmortem_dir,
+                             shard=args.process_id)
+
+    def _exception_postmortem():
+        # crash-dump-on-unhandled-exception: the Python twin of the
+        # fatal-signal path — same dump format (signal 0 =
+        # "exception"), so an incident reads identically whether the
+        # process died in native or Python code. The exception itself
+        # still propagates (the traceback is the Python half of the
+        # postmortem).
+        if not args.postmortem_dir:
+            return
+        path = os.path.join(
+            args.postmortem_dir,
+            f"postmortem.{os.getpid()}.exception.json",
+        )
+        try:
+            blackbox_mod.write_postmortem(path)
+            log.error("unhandled exception; postmortem: %s", path)
+        except Exception:
+            log.exception("postmortem dump failed")
+
+    try:
+        graph, services = build_graph(args)
+    except Exception:
+        _exception_postmortem()
+        raise
     try:
         mesh = make_mesh(args.num_devices, model_parallel=args.model_parallel)
         # multi-chip device sampling: keep the fused Pallas draw by
@@ -854,6 +897,9 @@ def main(argv=None) -> int:
             run_evaluate(model, graph, args, mesh)
         else:
             run_save_embedding(model, graph, args, mesh)
+    except Exception:
+        _exception_postmortem()
+        raise
     finally:
         from euler_tpu.graph import device as device_graph
 

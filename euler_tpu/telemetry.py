@@ -259,6 +259,17 @@ _GAUGE_FAMILIES = {
     "draining": ("eg_draining", "1 while the server drains"),
 }
 
+# Process resource gauges (eg_blackbox.h: sampled live for every dump,
+# background-sampled into the HISTORY ring, frozen into postmortems).
+_RESOURCE_FAMILIES = {
+    "rss_bytes": ("eg_rss_bytes",
+                  "Resident set size of the process, bytes"),
+    "open_fds": ("eg_open_fds", "Open file descriptors"),
+    "threads": ("eg_threads", "Live OS threads"),
+    "cache_bytes": ("eg_cache_bytes",
+                    "Client feature-row cache resident bytes"),
+}
+
 
 def _fmt_labels(labels: dict) -> str:
     if not labels:
@@ -331,6 +342,20 @@ def _render(sources: list) -> str:
                 lines.append(f"# TYPE {fam} gauge")
                 emitted_header = True
             lines.append(f"{fam}{_fmt_labels(dict(base))} {gauges[gkey]}")
+
+    for rkey, (fam, help_text) in _RESOURCE_FAMILIES.items():
+        emitted_header = False
+        for data, base in sources:
+            resource = data.get("resource")
+            if resource is None or rkey not in resource:
+                continue
+            if not emitted_header:
+                lines.append(f"# HELP {fam} {help_text}")
+                lines.append(f"# TYPE {fam} gauge")
+                emitted_header = True
+            lines.append(
+                f"{fam}{_fmt_labels(dict(base))} {resource[rkey]}"
+            )
 
     return "\n".join(lines) + "\n"
 

@@ -16,7 +16,9 @@ STATS wire opcode (eg_telemetry), and prints per shard:
 With `--watch N` it re-scrapes every N seconds and prints DELTA columns
 (requests served, counter movement) next to the live gauges — the
 at-a-glance view for watching a rolling restart or a load drill without
-a Prometheus stack. Step-phase histograms (OBSERVABILITY.md "Step
+a Prometheus stack. A transiently unreachable shard (mid-restart,
+crashed, draining) is skipped-and-noted, never aborts the watch; its
+deltas resume from the last good scrape once it answers again. Step-phase histograms (OBSERVABILITY.md "Step
 phases") print whenever a scraped process has recorded any — shard
 services normally haven't (phases live in the training client), but an
 in-process cluster or a future co-located trainer shows them here.
@@ -120,6 +122,7 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
     from euler_tpu import telemetry as T
 
     prev: dict = {}
+    unreachable: set = set()
     n = 0
     while iterations is None or n < iterations:
         if n:
@@ -129,9 +132,18 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
             try:
                 data = T.scrape(graph, s)
             except Exception as e:
-                print(f"[{stamp}] shard {s}: scrape failed ({e})",
-                      file=out)
+                # a transiently unreachable shard is ROUTINE during a
+                # rolling restart (DEPLOY.md drill): skip-and-note, keep
+                # watching the rest — the watch must outlive the blip.
+                # prev[s] is kept, so deltas resume from the last good
+                # scrape when the shard comes back.
+                unreachable.add(s)
+                print(f"[{stamp}] shard {s}: unreachable — skipped "
+                      f"({type(e).__name__}: {e})", file=out)
                 continue
+            if s in unreachable:
+                unreachable.discard(s)
+                print(f"[{stamp}] shard {s}: reachable again", file=out)
             served = _served_total(data)
             ctr = {k: v for k, v in data["counters"].items() if v}
             last = prev.get(s, {})

@@ -176,6 +176,12 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on a regression verdict (default: warn)")
+    ap.add_argument("--strict-configs", default="", metavar="A,B", help=(
+        "enforce (exit 1) regressions only for these config names "
+        "(e.g. bench_smoke); others stay warn-only. The verify.sh "
+        "middle ground: the host-only bench config is stable enough "
+        "to gate on, the remote configs drown in 1-core container "
+        "noise"))
     ap.add_argument("--tolerance", type=float, default=0.25, help=(
         "allowed fractional drop below the best prior smoke round "
         "before the verdict says regression (container noise floor)"))
@@ -223,19 +229,27 @@ def main() -> int:
             args.history,
         )
 
+    strict_configs = {c.strip() for c in args.strict_configs.split(",")
+                      if c.strip()}
     print("== perf gate verdict (smoke-to-smoke, "
           f"tolerance {args.tolerance:.0%}) ==")
     regressed = False
+    enforced = False
     for config, status, detail in results:
         tag = {"ok": "OK", "regression": "REGRESSION",
                "baseline": "BASELINE"}[status]
-        print(f"  {config:14s} {tag:10s} {detail}")
+        gating = args.strict or config in strict_configs
+        print(f"  {config:14s} {tag:10s} {detail}"
+              + ("" if gating or status != "regression"
+                 else " [warn-only config]"))
         regressed |= status == "regression"
+        enforced |= status == "regression" and gating
     if regressed:
         print("perf_gate: REGRESSION "
-              + ("(--strict: failing)" if args.strict
-                 else "(warn-only; pass --strict to enforce)"))
-        return 1 if args.strict else 0
+              + ("(enforced: failing)" if enforced
+                 else "(warn-only; pass --strict or --strict-configs "
+                      "to enforce)"))
+        return 1 if enforced else 0
     print("perf_gate: OK")
     return 0
 

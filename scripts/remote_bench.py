@@ -267,14 +267,17 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
             reg, steps, batch, fanouts, feature_dim, "optimized",
             telemetry=True,
         )
-        # TELEMETRY A/B: the optimized path with the observability
-        # kill-switch thrown — the <2% overhead contract of
-        # eg_telemetry (PERF.md "Telemetry overhead"). The config key
-        # is process-global, so the client AND the in-process shards
-        # all stop recording; re-enabled in the finally below.
+        # TELEMETRY A/B: the optimized path with BOTH observability
+        # kill-switches thrown — telemetry (histograms/spans/phases)
+        # AND the blackbox flight recorder — so the <2% overhead
+        # contract (PERF.md "Telemetry overhead") prices every recorder
+        # on the hot path, eg_blackbox's ring writes included. The
+        # config keys are process-global, so the client AND the
+        # in-process shards all stop recording; re-enabled in the
+        # finally below.
         tel_off = bench_config(
             reg, steps, batch, fanouts, feature_dim, "telemetry_off",
-            telemetry=False,
+            telemetry=False, blackbox=False,
         )
         telemetry_overhead_pct = round(
             (tel_off["edges_per_sec"] - after["edges_per_sec"])
@@ -313,9 +316,11 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
             },
         }
     finally:
+        from euler_tpu.blackbox import set_blackbox
         from euler_tpu.telemetry import set_telemetry
 
         set_telemetry(True)  # the kill-switch A/B is process-global
+        set_blackbox(True)
         for p in procs:
             if hasattr(p, "stop"):
                 p.stop()

@@ -61,6 +61,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/trace_dump.py --smoke >/dev/null || fail=1
 
+step "blackbox postmortem drill (OBSERVABILITY.md 'Postmortems')"
+# The flight-recorder/crash-dump suites by name, then the incident
+# drill: a seeded crash failpoint kills a live shard, the postmortem is
+# collected and merged with the client trace by trace id — a silent
+# regression in the forensic path fails verify before the incident
+# that needed it.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_blackbox.py -q -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/postmortem.py --smoke >/dev/null || fail=1
+
 step "rolling-restart drill + connection storm + wire fuzz (DEPLOY.md runbook)"
 # Server-side survivability: SIGTERM-drain/restart of every shard
 # mid-training with zero failed calls, BUSY load-shedding under a
@@ -70,12 +81,14 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_rolling_restart.py tests/test_wire_fuzz.py -q \
   -p no:cacheprovider || fail=1
 
-step "perf gate (scripts/perf_gate.py — WARN-ONLY, never gates verify)"
+step "perf gate (scripts/perf_gate.py — strict for bench_smoke, warn-only remote)"
 # Smoke-to-smoke throughput trajectory check (PERF.md "Throughput
-# trajectory"): a silent perf regression gets shouted here; run
-# `perf_gate.py --strict` to enforce it.
+# trajectory"). The host-only bench.py --smoke config now GATES verify
+# (its history has a multi-round trajectory and it runs without the
+# remote path's 1-core container noise); the remote configs stay
+# warn-only. `perf_gate.py --strict` enforces everything.
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
-  python scripts/perf_gate.py || echo "perf_gate: WARN (non-gating)"
+  python scripts/perf_gate.py --strict-configs bench_smoke || fail=1
 
 step "python syntax floor (compileall)"
 # stdlib floor under the optional tools above: at minimum, every file parses

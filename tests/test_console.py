@@ -69,6 +69,22 @@ def test_error_does_not_kill_repl(console, capsys):
     assert "error:" in capsys.readouterr().err
 
 
+def test_stats_blackbox_shows_recorder_and_resources(console, capsys):
+    """`stats blackbox` prints the flight-recorder state and the live
+    resource gauges (OBSERVABILITY.md 'Postmortems')."""
+    from euler_tpu import blackbox as B
+
+    B.blackbox_reset()
+    B.set_blackbox(True)
+    B.record("app", value=123)
+    console.execute("stats blackbox")
+    out = capsys.readouterr().out
+    assert "blackbox on" in out
+    assert "rss" in out and "fds" in out and "threads" in out
+    assert "app" in out  # the recorded event's point in a ring tail
+    B.blackbox_reset()
+
+
 def test_stats_span_timers(console, capsys):
     """The native span-timer subsystem records ops and resets."""
     import euler_tpu

@@ -35,10 +35,13 @@ COUNTER_NAMES = {
     # training input pipeline ledger (PR 6): prefetch production/drop
     # accounting and dead-worker visibility
     "prefetch_produced", "prefetch_dropped", "prefetch_worker_errors",
+    # postmortem ledger (PR 7): fires of the seeded crash failpoint,
+    # counted before the raise so the dump's snapshot includes them
+    "crashes",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
-    "heartbeat", "accept", "handler_stall", "busy_force",
+    "heartbeat", "accept", "handler_stall", "busy_force", "crash",
 }
 
 

@@ -88,6 +88,32 @@ def test_strict_flag_gates_a_regression(tmp_path, monkeypatch, capsys,
     assert "REGRESSION" in out
 
 
+@pytest.mark.parametrize("listed,expected_rc", [(False, 0), (True, 1)])
+def test_strict_configs_gate_only_named_configs(tmp_path, monkeypatch,
+                                                capsys, listed,
+                                                expected_rc):
+    """--strict-configs enforces per config: a regression in a listed
+    config fails, the same regression in an unlisted one stays a
+    warning — the verify.sh shape (host bench gates, remote noise
+    doesn't)."""
+    hist = str(tmp_path / "hist.jsonl")
+    perf_gate.append_history(
+        {"unix": 1, "values": {"remote_smoke": 10_000_000.0}}, hist
+    )
+    monkeypatch.setattr(perf_gate, "run_smoke_remote",
+                        lambda timeout_s: {"value": 1_000_000.0})
+    configs = "remote_smoke" if listed else "bench_smoke"
+    monkeypatch.setattr(sys, "argv", [
+        "perf_gate.py", "--skip-bench", "--history", hist, "--no-record",
+        "--strict-configs", configs,
+    ])
+    assert perf_gate.main() == expected_rc
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out
+    if not listed:
+        assert "[warn-only config]" in out
+
+
 def test_verdict_json_is_append_only(tmp_path, monkeypatch):
     """A run records its smoke values into the history for the next
     round's comparison (unless --no-record)."""

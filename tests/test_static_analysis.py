@@ -42,16 +42,18 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 30, files  # all .cc and .h of _native
+    assert len(files) >= 32, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
     # dispatcher + feature cache), the server survivability layer
-    # (bounded admission), the telemetry subsystem, and the step-phase
-    # profiler must be under the gate, not grandfathered around it
+    # (bounded admission), the telemetry subsystem, the step-phase
+    # profiler, and the blackbox flight recorder must be under the
+    # gate, not grandfathered around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
         "eg_cache.cc", "eg_cache.h", "eg_admission.cc", "eg_admission.h",
         "eg_telemetry.cc", "eg_telemetry.h", "eg_phase.cc", "eg_phase.h",
+        "eg_blackbox.cc", "eg_blackbox.h",
     } <= names, names
     violations = []
     for f in files:
@@ -371,6 +373,74 @@ def test_raw_lock_fires_on_phase_snapshot_shape():
     )
     violations = only_rule(lint(snippet), "raw-lock")
     assert [v.line for v in violations] == [2, 4]
+
+
+# ---------------------------------------------------------------------------
+# blackbox shapes: the flight-recorder/postmortem layer (eg_blackbox)
+# stays under the gate — the signal-handler path is a prime candidate
+# for exactly the crash classes these rules pin
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_blackbox_record_shape():
+    """The flight-recorder ABI is on the hot path of every finished
+    RPC and every step phase — a guardless eg_blackbox_record-shaped
+    entry point would carry a native exception straight across ctypes
+    (std::terminate, which is itself a SIGABRT the blackbox would then
+    try to dump: a recursion nobody wants)."""
+    snippet = (
+        'extern "C" {\n'
+        "void eg_blackbox_record(int point, int op, uint64_t trace) {\n"
+        "  eg::Blackbox::Global().Record(point, op, trace);\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_blackbox_record" in v.message
+
+
+def test_raw_lock_fires_on_signal_handler_dump_shape():
+    """A fatal-signal dump path that takes a mutex is a deadlock the
+    moment the crashing thread already holds it — the raw-lock rule
+    catches the shape (the REAL dump path must stay atomics + write(2)
+    only; even an RAII guard would be wrong there, and that design
+    constraint is what OBSERVABILITY.md 'Postmortems' documents)."""
+    snippet = (
+        "void DumpToFd(int fd, int sig) {\n"
+        "  mu_.lock();\n"
+        "  WriteRings(fd);\n"
+        "  mu_.unlock();\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+def test_thread_catch_fires_on_resource_sampler_shape():
+    """The background resource sampler parses /proc forever — its
+    entry lambda stays under thread-catch like every service thread (a
+    dead sampler must freeze the history, not the process)."""
+    snippet = (
+        "void Install() {\n"
+        "  std::thread([this] { SamplerLoop(); }).detach();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
+
+
+def test_wire_count_alloc_fires_on_postmortem_derived_count():
+    """A postmortem/scrape reader sizing a buffer from a file-derived
+    ring head is the same bound-before-alloc crash class as any wire
+    count — a truncated dump must not OOM the collector."""
+    snippet = (
+        "void LoadRings(WireReader* r) {\n"
+        "  int64_t head = r->I64();\n"
+        "  std::vector<Event> events(head);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert "head" in v.message
 
 
 # ---------------------------------------------------------------------------
