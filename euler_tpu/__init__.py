@@ -51,6 +51,12 @@ from euler_tpu.blackbox import (
     postmortem_read,
     set_blackbox,
 )
+from euler_tpu.heat import (
+    heat_json,
+    heat_reset,
+    heat_topk,
+    set_heat,
+)
 
 __version__ = "0.2.0"
 
@@ -60,5 +66,5 @@ __all__ = [
     "fault_config", "fault_clear", "fault_injected", "metrics_text",
     "scrape", "set_telemetry", "slow_spans", "telemetry_json",
     "telemetry_reset", "blackbox_json", "postmortem_read",
-    "set_blackbox",
+    "set_blackbox", "heat_json", "heat_topk", "heat_reset", "set_heat",
 ]

@@ -63,9 +63,11 @@ COMMANDS = {
         "histograms (p50/p90/p99 per op), 'phases' for the step-phase "
         "profiler (input_stall/sample/h2d/device + prefetch gauges), "
         "'slow' for the slow-span journal, 'blackbox' for the flight "
-        "recorder + resource gauges, 'reset' to zero everything",
-        "stats [hist|phases|slow|blackbox|reset]",
-        "stats phases",
+        "recorder + resource gauges, 'heat' for the data-plane access "
+        "profiler (hot-vertex top-K, fan-out, cache classes), 'reset' "
+        "to zero everything",
+        "stats [hist|phases|slow|blackbox|heat|reset]",
+        "stats heat",
     ),
     "quit": ("Exit the console", "quit", "quit"),
 }
@@ -353,6 +355,52 @@ class Console:
                           f"value={e['value']:<8d} "
                           f"trace={int(e['trace']):#x}")
             return
+        if args and args[0] == "heat":
+            # data-plane access profiler (eg_heat, OBSERVABILITY.md
+            # "Data-plane heat"): hot-vertex top-K per side, the
+            # client ids ledger, and cache-efficacy classes
+            from euler_tpu.heat import heat_json, topk_share
+
+            d = heat_json()
+            state = "on" if d["enabled"] else "OFF"
+            tot = d["sketch"]["total"]
+            print(f"heat {state}  topk_capacity {d['topk_capacity']}  "
+                  f"ids fed: client {tot['client']} server "
+                  f"{tot['server']}")
+            any_rows = False
+            for side in ("client", "server"):
+                top = d["topk"][side]
+                if not top:
+                    continue
+                any_rows = True
+                share = topk_share(d, side)
+                print(f"{side} top-{len(top)} (share of stream "
+                      f"{share:.1%}):")
+                print(f"  {'rank':>4s} {'id':>12s} {'count':>10s} "
+                      f"{'err':>8s}")
+                for rank, e in enumerate(top[:10], 1):
+                    print(f"  {rank:4d} {e['id']:12d} {e['count']:10d} "
+                          f"{e['err']:8d}")
+            if not any_rows:
+                print("no ids fed yet (run remote queries with heat on)")
+                return
+            if d["fanout"]:
+                print(f"{'op':22s} {'calls':>7s} {'requested':>10s} "
+                      f"{'deduped':>8s} {'cache_hit':>9s} "
+                      f"{'on_wire':>8s} {'shards':>7s}")
+                for op, f in sorted(d["fanout"].items()):
+                    print(f"{op:22s} {f['calls']:7d} "
+                          f"{f['ids_requested']:10d} "
+                          f"{f['ids_deduped']:8d} {f['cache_hits']:9d} "
+                          f"{f['ids_on_wire']:8d} "
+                          f"{f['shards_touched']:7d}")
+            cc = d["cache_class"]
+            if any(sum(v) for v in cc.values()):
+                print("cache events by frequency class "
+                      "(class c = estimate in [2^(c-1), 2^c)):")
+                for event in ("hit", "miss", "evict"):
+                    print(f"  {event:6s} {cc[event]}")
+            return
         if args and args[0] == "slow":
             from euler_tpu.telemetry import slow_spans
 
@@ -387,6 +435,11 @@ class Console:
             print("counters:")
             for name, v in sorted(fails.items()):
                 print(f"  {name:20s} {v:10d}")
+        # the full subcommand roster, so the bare command advertises
+        # every surface (the help text stopped being updated after the
+        # telemetry PR — now generated-ish: keep in step with COMMANDS)
+        print("subcommands: stats hist | phases | slow | blackbox | "
+              "heat | reset")
 
     def execute(self, line: str) -> bool:
         """Run one command line; returns False on quit."""

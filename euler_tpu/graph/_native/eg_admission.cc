@@ -15,6 +15,7 @@
 
 #include "eg_blackbox.h"
 #include "eg_fault.h"
+#include "eg_heat.h"
 #include "eg_stats.h"
 #include "eg_telemetry.h"
 #include "eg_wire.h"
@@ -109,12 +110,22 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
       opt->slow_spans = v;
     } else if (key == "blackbox") {
       opt->blackbox = v != 0 ? 1 : 0;
+    } else if (key == "heat") {
+      opt->heat = v != 0 ? 1 : 0;
+    } else if (key == "heat_topk") {
+      if (v < 1 || v > kHeatMaxTopK) {
+        *err = "heat_topk must be 1.." + std::to_string(kHeatMaxTopK) +
+               " (fixed top-K tracker pool)";
+        return false;
+      }
+      opt->heat_topk = v;
     } else {
       // loudness rule: a typo'd key must not be dropped silently
       *err = "unknown service option '" + key +
              "' (known: workers, pending, max_conns, io_timeout_ms, "
              "idle_timeout_ms, linger_ms, drain_ms, wire_version, "
-             "telemetry, slow_spans, blackbox, postmortem_dir)";
+             "telemetry, slow_spans, blackbox, heat, heat_topk, "
+             "postmortem_dir)";
       return false;
     }
   }
@@ -134,6 +145,10 @@ bool AdmissionServer::Start(int listen_fd, const AdmissionOptions& opt,
   // blackbox=/postmortem_dir= options: the server half of the flight-
   // recorder kill-switch and the fatal-signal dump path (eg_blackbox.h)
   if (opt_.blackbox >= 0) Blackbox::Global().SetEnabled(opt_.blackbox != 0);
+  // heat=/heat_topk= options: the server half of the data-plane heat
+  // profiler's switches (eg_heat.h)
+  if (opt_.heat >= 0) Heat::Global().SetEnabled(opt_.heat != 0);
+  if (opt_.heat_topk > 0) Heat::Global().SetTopK(opt_.heat_topk);
   if (!opt_.postmortem_dir.empty() &&
       !Blackbox::Global().Install(opt_.postmortem_dir, opt_.shard_idx)) {
     *err = Blackbox::Global().error();
@@ -429,6 +444,11 @@ void AdmissionServer::WorkerLoop() {
 
 void AdmissionServer::ServeConn(ReadyConn c) {
   Counters& ctr = Counters::Global();
+  // Requesting-conn tag for the data-plane heat feeds (eg_heat.h):
+  // Service::Dispatch runs on this thread and reads it back, so the
+  // shard's per-conn id ledger can name WHO generates the hot traffic
+  // without widening the handler signature.
+  HeatSetConn(c.fd);
   std::string req, reply;
   int64_t ready_ms = c.ready_ms;
   for (;;) {

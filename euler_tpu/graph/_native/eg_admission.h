@@ -74,6 +74,9 @@ struct AdmissionOptions {
   int slow_spans = 0;        // >0 = slow-span journal capacity
   int blackbox = -1;         // -1 = leave the process-global blackbox
                              // switch alone; 0/1 set it (eg_blackbox.h)
+  int heat = -1;             // -1 = leave the process-global heat
+                             // profiler alone; 0/1 set it (eg_heat.h)
+  int heat_topk = 0;         // >0 = hot-key tracker capacity (resets it)
   std::string postmortem_dir;  // non-empty: arm the fatal-signal dump
                                // path for this serving process
   int shard_idx = -1;        // set programmatically by Service::Start so
@@ -82,8 +85,8 @@ struct AdmissionOptions {
 
 // Parse "k=v;k=v" admission options (workers/pending/max_conns/
 // io_timeout_ms/idle_timeout_ms/linger_ms/drain_ms/wire_version/
-// telemetry/slow_spans/blackbox/postmortem_dir). Unknown keys and
-// malformed numbers fail loudly: false + *err.
+// telemetry/slow_spans/blackbox/heat/heat_topk/postmortem_dir).
+// Unknown keys and malformed numbers fail loudly: false + *err.
 bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
                            std::string* err);
 

@@ -2,6 +2,8 @@
 
 #include <cstring>
 
+#include "eg_heat.h"
+
 namespace eg {
 
 std::atomic<int64_t>& GlobalCacheBytes() {
@@ -58,7 +60,11 @@ bool FeatureCache::Get(uint64_t spec, uint64_t id, float* out,
   std::lock_guard<std::mutex> l(st.mu);
   auto it = st.map.find(key);
   // the full (spec, id, dim) identity is verified: a key collision is a
-  // miss, never somebody else's row
+  // miss, never somebody else's row. (Cache-efficacy hit/miss classes
+  // are accounted by the dense-feature caller, which already holds each
+  // probed id's frequency class from its heat feed — see eg_heat.h
+  // AddCacheClasses; the eviction hook below stays here because only
+  // the cache knows its victims.)
   if (it == st.map.end() || it->second.spec != spec || it->second.id != id ||
       it->second.row.size() != row_dim)
     return false;
@@ -85,6 +91,10 @@ void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
     st.bytes -= freed;
     GlobalCacheBytes().fetch_sub(static_cast<int64_t>(freed),
                                  std::memory_order_relaxed);
+    // eviction bucketed by the VICTIM's frequency class: a hot row
+    // evicted by FIFO is exactly the event a frequency-aware admission
+    // policy would prevent (ROADMAP item 5's cache question)
+    Heat::Global().RecordCacheEvent(kHeatCacheEvict, victim->second.id);
     st.map.erase(victim);
   }
   Entry e;

@@ -15,6 +15,7 @@
 #include "eg_blackbox.h"
 #include "eg_engine.h"
 #include "eg_fault.h"
+#include "eg_heat.h"
 #include "eg_phase.h"
 #include "eg_registry.h"
 #include "eg_sampling.h"
@@ -665,13 +666,14 @@ void eg_telemetry_set_enabled(int on) {
   EG_API_GUARD()
 }
 
-// Zero histograms (latency AND step-phase) + the slow-span journal
-// (enabled flag and journal capacity survive — this is the clean-slate
-// primitive tests use).
+// Zero histograms (latency AND step-phase) + the slow-span journal +
+// the data-plane heat state (enabled flags and capacities survive —
+// this is the clean-slate primitive tests use).
 void eg_telemetry_reset() {
   try {
     eg::Telemetry::Global().Reset();
     eg::PhaseStats::Global().Reset();
+    eg::Heat::Global().Reset();
   }
   EG_API_GUARD()
 }
@@ -761,6 +763,93 @@ int eg_remote_scrape(void* h, int shard, char* buf, int cap) {
     if (!static_cast<RemoteGraph*>(API(h))->ScrapeShard(shard, &js)) {
       g_last_error = "telemetry scrape failed: shard " +
                      std::to_string(shard) + " unreachable or invalid";
+      return -1;
+    }
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
+}
+
+// ---- data-plane heat profiler (eg_heat.h; OBSERVABILITY.md
+// "Data-plane heat") ----
+int eg_heat_enabled() {
+  try {
+    return eg::Heat::Global().flag() ? 1 : 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+void eg_heat_set_enabled(int on) {
+  try {
+    eg::Heat::Global().SetEnabled(on != 0);
+  }
+  EG_API_GUARD()
+}
+
+// Resize (and reset) the hot-key tracker (`heat_topk=` config key).
+void eg_heat_set_topk(int k) {
+  try {
+    eg::Heat::Global().SetTopK(k);
+  }
+  EG_API_GUARD()
+}
+
+// Feed a batch of ids from Python (app-level access streams, and the
+// exactness tests that pin the sketch against ground truth). side:
+// 0 = client, 1 = server; op indexes kWireOpNames (0 = other).
+void eg_heat_record(int side, int op, const uint64_t* ids, int64_t n) {
+  try {
+    eg::Heat::Global().Record(side, op, ids, n);
+  }
+  EG_API_GUARD()
+}
+
+// Count-min point estimate for one id (>= its true feed count).
+uint64_t eg_heat_estimate(int side, uint64_t id) {
+  try {
+    return eg::Heat::Global().Estimate(side, id);
+  }
+  EG_API_GUARD(0)
+}
+
+// Local heat dump as JSON (top-K tables, sketch totals, per-op ids
+// ledger, fan-out attribution, cache classes). Same buf/cap/return
+// contract as eg_telemetry_json.
+int eg_heat_json(char* buf, int cap) {
+  try {
+    std::string js = eg::Heat::Global().Json(-1);
+    if (cap > 0) {
+      size_t m = std::min(js.size(), static_cast<size_t>(cap - 1));
+      memcpy(buf, js.data(), m);
+      buf[m] = '\0';
+    }
+    return static_cast<int>(js.size());
+  }
+  EG_API_GUARD(-1)
+}
+
+// Zero the heat state (enabled flag + top-K capacity survive).
+void eg_heat_reset() {
+  try {
+    eg::Heat::Global().Reset();
+  }
+  EG_API_GUARD()
+}
+
+// Remote heat scrape (kHeat opcode): fetch shard `shard`'s full heat
+// dump. Same buf/cap/return contract as eg_remote_scrape; -1 on
+// transport failure or bad shard index.
+int eg_remote_heat(void* h, int shard, char* buf, int cap) {
+  try {
+    std::string js;
+    if (!static_cast<RemoteGraph*>(API(h))->HeatShard(shard, &js)) {
+      g_last_error = "heat scrape failed: shard " + std::to_string(shard) +
+                     " unreachable or invalid";
       return -1;
     }
     if (cap > 0) {

@@ -14,6 +14,7 @@
 
 #include "eg_blackbox.h"
 #include "eg_fault.h"
+#include "eg_heat.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
 #include "eg_telemetry.h"
@@ -492,6 +493,20 @@ bool RemoteGraph::Init(const std::string& config) {
   // (eg_telemetry.h) — process-global, like the failpoint registry.
   if (cfg.count("telemetry"))
     Telemetry::Global().SetEnabled(std::stoi(cfg["telemetry"]) != 0);
+  // Data-plane heat profiler (eg_heat.h) — process-global: heat=0
+  // stops id feeds/fan-out/cache-class recording, heat_topk= resizes
+  // (and resets) the hot-key tracker.
+  if (cfg.count("heat"))
+    Heat::Global().SetEnabled(std::stoi(cfg["heat"]) != 0);
+  if (cfg.count("heat_topk")) {
+    int k = std::stoi(cfg["heat_topk"]);
+    if (k < 1 || k > kHeatMaxTopK) {
+      error_ = "heat_topk must be 1.." + std::to_string(kHeatMaxTopK) +
+               " (fixed top-K tracker pool)";
+      return false;
+    }
+    Heat::Global().SetTopK(k);
+  }
   if (cfg.count("slow_spans")) {
     int cap = std::stoi(cfg["slow_spans"]);
     if (cap < 1) {
@@ -722,6 +737,18 @@ bool RemoteGraph::HistoryShard(int shard, std::string* json) const {
   if (shard < 0 || shard >= num_shards_) return false;
   WireWriter req;
   req.U8(kHistory);
+  std::string reply;
+  if (!Call(shard, req.buf(), &reply)) return false;
+  WireReader r(reply);
+  r.U8();  // status already checked in Call
+  *json = r.Str();
+  return r.ok();
+}
+
+bool RemoteGraph::HeatShard(int shard, std::string* json) const {
+  if (shard < 0 || shard >= num_shards_) return false;
+  WireWriter req;
+  req.U8(kHeat);
   std::string reply;
   if (!Call(shard, req.buf(), &reply)) return false;
   WireReader r(reply);
@@ -965,6 +992,10 @@ void RemoteGraph::GetNodeType(const uint64_t* ids, int n,
   RunChunked(plan.rows, "node_type", [&](int s, int32_t b, int32_t e) {
     std::vector<uint64_t> sub(static_cast<size_t>(e - b));
     for (int32_t j = b; j < e; ++j) sub[j - b] = ids[plan.rows[s][j]];
+    // heat feed (eg_heat.h): every id that goes on the wire,
+    // post-coalesce, tagged by op — this runs ON the dispatcher worker
+    Heat::Global().Record(kHeatClient, kNodeType, sub.data(),
+                          static_cast<int64_t>(sub.size()));
     WireWriter req;
     req.U8(kNodeType);
     req.Arr(sub);
@@ -1002,6 +1033,8 @@ bool RemoteGraph::GetNodeWeight(const uint64_t* ids, int n,
   RunChunked(plan.rows, "node_weight", [&](int s, int32_t b, int32_t e) {
     std::vector<uint64_t> sub(static_cast<size_t>(e - b));
     for (int32_t j = b; j < e; ++j) sub[j - b] = ids[plan.rows[s][j]];
+    Heat::Global().Record(kHeatClient, kNodeWeight, sub.data(),
+                          static_cast<int64_t>(sub.size()));
     WireWriter req;
     req.U8(kNodeWeight);
     req.Arr(sub);
@@ -1125,6 +1158,9 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
       sub[j - b] = ids[plan.rows[s][j]];
       subreps[j - b] = plan.reps[s][j];
     }
+    Heat::Global().Record(
+        kHeatClient, coalesce_ ? kSampleNeighborUniq : kSampleNeighbor,
+        sub.data(), static_cast<int64_t>(sub.size()));
     WireWriter req;
     if (coalesce_) {
       // dedup'd form: each unique id once, with its repeat count
@@ -1142,6 +1178,7 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
     req.U64(default_id);
     std::string reply;
     if (!Call(s, req.buf(), &reply)) return false;
+    Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
     WireReader r(reply);
     r.U8();
     int64_t mi, mw, mt;
@@ -1157,6 +1194,23 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
     for (int32_t j = b; j < e; ++j) ok[s][j] = 1;
     return true;
   });
+  // fan-out attribution (eg_heat.h): ids_on_wire MEASURED as the sum of
+  // the per-shard unique lists, so the heat surface's ledger identity
+  // (ids_on_wire == ids_requested - ids_deduped - cache_hits) is a real
+  // cross-check of the coalescing plan, not a restatement
+  if (Heat::Global().enabled()) {
+    uint64_t uniq = 0;
+    int touched = 0;
+    for (int s = 0; s < num_shards_; ++s)
+      if (!plan.rows[s].empty()) {
+        ++touched;
+        uniq += plan.rows[s].size();
+      }
+    Heat::Global().RecordFanout(kSampleNeighbor,
+                                static_cast<uint64_t>(n),
+                                static_cast<uint64_t>(plan.coalesced), 0,
+                                uniq, touched);
+  }
   for (int i = 0; i < n; ++i) {
     int s = plan.shard_of[i];
     int32_t pos = plan.pos_of[i];
@@ -1306,6 +1360,8 @@ EGResult* RemoteGraph::GetFullNeighbor(const uint64_t* ids, int n,
     std::vector<uint64_t> subids(plan.rows[s].size());
     for (size_t j = 0; j < plan.rows[s].size(); ++j)
       subids[j] = ids[plan.rows[s][j]];
+    Heat::Global().Record(kHeatClient, kFullNeighbor, subids.data(),
+                          static_cast<int64_t>(subids.size()));
     WireWriter req;
     req.U8(kFullNeighbor);
     req.Arr(subids);
@@ -1349,6 +1405,8 @@ void RemoteGraph::GetTopKNeighbor(const uint64_t* ids, int n,
   RunChunked(plan.rows, "topk_neighbor", [&](int s, int32_t b, int32_t e) {
     std::vector<uint64_t> sub(static_cast<size_t>(e - b));
     for (int32_t j = b; j < e; ++j) sub[j - b] = ids[plan.rows[s][j]];
+    Heat::Global().Record(kHeatClient, kTopKNeighbor, sub.data(),
+                          static_cast<int64_t>(sub.size()));
     WireWriter req;
     req.U8(kTopKNeighbor);
     req.Arr(sub);
@@ -1483,11 +1541,28 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
   std::vector<std::vector<float>> sval(num_shards_);
   std::vector<std::vector<char>> ok(num_shards_);
   std::vector<std::vector<int32_t>> fetch(num_shards_);
+  // heat feed (eg_heat.h): every unique id, post-coalesce but PRE-cache
+  // — cache hits are accesses too, and the frequency the cache-efficacy
+  // classes bucket by must count them. The gather form walks the plan's
+  // row indices in place (no staging copy), and hands back each id's
+  // frequency class from the same sketch walk, so the hit/miss class
+  // accounting below costs two array reads per id instead of a second
+  // sketch probe.
+  Heat& heat = Heat::Global();
+  const bool heat_on = heat.enabled();
+  std::vector<uint8_t> cls;
+  uint32_t cls_hit[kHeatClasses] = {0}, cls_miss[kHeatClasses] = {0};
   uint64_t hits = 0, misses = 0;
   for (int s = 0; s < num_shards_; ++s) {
     size_t m = plan.rows[s].size();
     sval[s].assign(m * static_cast<size_t>(row_dim), 0.f);
     ok[s].assign(m, 0);
+    if (heat_on && m) {
+      cls.resize(m);
+      heat.RecordRows(kHeatClient, kDenseFeature, ids,
+                      plan.rows[s].data(), static_cast<int64_t>(m), -1,
+                      cls.data());
+    }
     for (size_t j = 0; j < m; ++j) {
       uint64_t id = ids[plan.rows[s][j]];
       if (use_cache &&
@@ -1495,12 +1570,17 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
                       static_cast<size_t>(row_dim))) {
         ok[s][j] = 1;
         ++hits;
+        if (heat_on) ++cls_hit[cls[j]];
       } else {
         fetch[s].push_back(static_cast<int32_t>(j));
-        if (use_cache) ++misses;
+        if (use_cache) {
+          ++misses;
+          if (heat_on) ++cls_miss[cls[j]];
+        }
       }
     }
   }
+  if (heat_on && use_cache) heat.AddCacheClasses(cls_hit, cls_miss);
   if (hits) ctr.Add(kCtrCacheHit, hits);
   if (misses) ctr.Add(kCtrCacheMiss, misses);
   RunChunked(fetch, "dense_feature", [&](int s, int32_t b, int32_t e) {
@@ -1515,6 +1595,7 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
     req.Arr(dims, nf);
     std::string reply;
     if (!Call(s, req.buf(), &reply)) return false;
+    Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
     WireReader r(reply);
     r.U8();
     int64_t mm;
@@ -1532,6 +1613,20 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
     }
     return true;
   });
+  // fan-out attribution: ids_on_wire measured as the post-cache fetch
+  // list sizes, shards_touched as the shards a fetch actually went to
+  if (heat_on) {
+    uint64_t on_wire = 0;
+    int touched = 0;
+    for (int s = 0; s < num_shards_; ++s)
+      if (!fetch[s].empty()) {
+        ++touched;
+        on_wire += fetch[s].size();
+      }
+    heat.RecordFanout(kDenseFeature, static_cast<uint64_t>(n),
+                      static_cast<uint64_t>(plan.coalesced), hits, on_wire,
+                      touched);
+  }
   for (int i = 0; i < n; ++i) {
     int s = plan.shard_of[i];
     if (s < 0) continue;
@@ -1567,6 +1662,9 @@ void RemoteGraph::GetEdgeDenseFeature(const uint64_t* src,
       sdst[j] = dst[rows[s][j]];
       st[j] = types[rows[s][j]];
     }
+    // edge ops feed their SRC ids — the routing key hash sharding cuts on
+    Heat::Global().Record(kHeatClient, kEdgeDenseFeature, ssrc.data(),
+                          static_cast<int64_t>(ssrc.size()));
     WireWriter req;
     req.U8(kEdgeDenseFeature);
     req.Arr(ssrc);
@@ -1598,6 +1696,8 @@ EGResult* RemoteGraph::GetSparseFeature(const uint64_t* ids, int n,
     std::vector<uint64_t> subids(plan.rows[s].size());
     for (size_t j = 0; j < plan.rows[s].size(); ++j)
       subids[j] = ids[plan.rows[s][j]];
+    Heat::Global().Record(kHeatClient, kSparseFeature, subids.data(),
+                          static_cast<int64_t>(subids.size()));
     WireWriter req;
     req.U8(kSparseFeature);
     req.Arr(subids);
@@ -1632,6 +1732,8 @@ EGResult* RemoteGraph::GetEdgeSparseFeature(const uint64_t* src,
       sdst[j] = dst[plan.rows[s][j]];
       st[j] = types[plan.rows[s][j]];
     }
+    Heat::Global().Record(kHeatClient, kEdgeSparseFeature, ssrc.data(),
+                          static_cast<int64_t>(ssrc.size()));
     WireWriter req;
     req.U8(kEdgeSparseFeature);
     req.Arr(ssrc);
@@ -1659,6 +1761,8 @@ EGResult* RemoteGraph::GetBinaryFeature(const uint64_t* ids, int n,
     std::vector<uint64_t> subids(plan.rows[s].size());
     for (size_t j = 0; j < plan.rows[s].size(); ++j)
       subids[j] = ids[plan.rows[s][j]];
+    Heat::Global().Record(kHeatClient, kBinaryFeature, subids.data(),
+                          static_cast<int64_t>(subids.size()));
     WireWriter req;
     req.U8(kBinaryFeature);
     req.Arr(subids);
@@ -1692,6 +1796,8 @@ EGResult* RemoteGraph::GetEdgeBinaryFeature(const uint64_t* src,
       sdst[j] = dst[plan.rows[s][j]];
       st[j] = types[plan.rows[s][j]];
     }
+    Heat::Global().Record(kHeatClient, kEdgeBinaryFeature, ssrc.data(),
+                          static_cast<int64_t>(ssrc.size()));
     WireWriter req;
     req.U8(kEdgeBinaryFeature);
     req.Arr(ssrc);

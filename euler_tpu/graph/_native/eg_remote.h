@@ -162,11 +162,16 @@ class RemoteGraph : public GraphAPI {
   //     retries raises through the C ABI (eg_remote_strict_error) instead
   //     of silently degrading its rows to defaults. Either way the
   //     failure is counted in `rpc_errors`.
-  // Observability keys (eg_telemetry.h; process-global):
+  // Observability keys (eg_telemetry.h / eg_heat.h; process-global):
   //   telemetry (default 1): 0 disables histograms + slow-span journals
   //     (counters and stats keep recording — the kill-switch covers the
   //     new subsystem only),
-  //   slow_spans (default 32): slowest-N span journal capacity.
+  //   slow_spans (default 32): slowest-N span journal capacity,
+  //   heat (default 1): 0 disables the data-plane access profiler
+  //     (hot-vertex top-K + sketch feeds, fan-out attribution,
+  //     cache-efficacy classes; telemetry=0 silences it too),
+  //   heat_topk (default 128, max 1024): hot-key tracker capacity
+  //     (resizing resets the tables).
   bool Init(const std::string& config);
   ~RemoteGraph() override;  // stops the re-discovery thread + dispatcher
   const std::string& error() const { return error_; }
@@ -188,6 +193,12 @@ class RemoteGraph : public GraphAPI {
   // cache ring as JSON — the live twin of a postmortem's frozen
   // resource_history. False on transport failure / bad shard index.
   bool HistoryShard(int shard, std::string* json) const;
+  // Data-plane heat of one live shard (kHeat opcode, eg_heat.h): the
+  // shard's full hot-vertex top-K table, sketch totals, per-op ids
+  // ledger and cache classes as JSON — the targeted scrape
+  // scripts/heat_dump.py builds its skew report from. False on
+  // transport failure / bad shard index.
+  bool HeatShard(int shard, std::string* json) const;
   // Pending strict-mode failure: copies + clears the first recorded
   // message. Empty string = no pending failure. (The fixed-shape query
   // ABI returns void, so strict failures surface through this side

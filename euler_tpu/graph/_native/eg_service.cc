@@ -11,6 +11,7 @@
 
 #include "eg_blackbox.h"
 #include "eg_fault.h"
+#include "eg_heat.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
 #include "eg_telemetry.h"
@@ -223,6 +224,16 @@ void Service::Dispatch(const char* req, size_t len,
   WireWriter w;
   w.U8(0);  // ok status; overwritten on decode error below
 
+  // Server-side heat feed (eg_heat.h): the decoded id array,
+  // PRE-execute, tagged by op + the requesting conn ServeConn stamped
+  // into the thread-local — so a shard's top-K table reflects what it
+  // was ASKED for even when the engine call later fails. Edge ops feed
+  // their src ids (the routing key hash sharding cuts on).
+  Heat& heat = Heat::Global();
+  auto feed = [&](const uint64_t* ids, int64_t n) {
+    heat.Record(kHeatServer, op, ids, n, HeatConn());
+  };
+
   switch (op) {
     case kPing:
       break;
@@ -246,6 +257,14 @@ void Service::Dispatch(const char* req, size_t len,
       // the last ~minute — so an operator can watch a shard leak before
       // it dies, not only read about it after.
       w.Str(Blackbox::Global().HistoryJson(shard_idx_));
+      break;
+    }
+    case kHeat: {
+      // Data-plane heat scrape (eg_heat.h): this shard's full
+      // hot-vertex top-K table + sketch totals + per-op/per-conn ids
+      // ledger — the targeted reply scripts/heat_dump.py fits its
+      // Zipf tail and cache-ceiling projections from.
+      w.Str(Heat::Global().Json(shard_idx_));
       break;
     }
     case kInfo: {
@@ -291,6 +310,7 @@ void Service::Dispatch(const char* req, size_t len,
     case kNodeType: {
       int64_t n;
       const uint64_t* ids = r.Arr<uint64_t>(&n);
+      if (r.ok()) feed(ids, n);
       std::vector<int32_t> out(static_cast<size_t>(n));
       if (r.ok()) engine_.GetNodeType(ids, static_cast<int>(n), out.data());
       w.Arr(out);
@@ -299,6 +319,7 @@ void Service::Dispatch(const char* req, size_t len,
     case kNodeWeight: {
       int64_t n;
       const uint64_t* ids = r.Arr<uint64_t>(&n);
+      if (r.ok()) feed(ids, n);
       std::vector<float> out(static_cast<size_t>(n));
       if (r.ok()) engine_.GetNodeWeight(ids, static_cast<int>(n), out.data());
       w.Arr(out);
@@ -310,6 +331,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* etypes = r.Arr<int32_t>(&net);
       int32_t count = r.I32();
       uint64_t def = r.U64();
+      if (r.ok()) feed(ids, n);
       if (OversizedResult(3LL * n * std::max<int32_t>(count, 0), reply))
         return;
       size_t total = static_cast<size_t>(n) * std::max<int32_t>(count, 0);
@@ -336,6 +358,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* etypes = r.Arr<int32_t>(&net);
       int32_t count = r.I32();
       uint64_t def = r.U64();
+      if (r.ok()) feed(ids, n);
       int64_t total = 0;
       bool shape_ok = r.ok() && nr == n && count >= 0;
       for (int64_t i = 0; shape_ok && i < n; ++i) {
@@ -376,6 +399,7 @@ void Service::Dispatch(const char* req, size_t len,
       const uint64_t* ids = r.Arr<uint64_t>(&n);
       const int32_t* etypes = r.Arr<int32_t>(&net);
       uint8_t sorted = r.U8();
+      if (r.ok()) feed(ids, n);
       if (r.ok()) {
         WriteResult(&w, engine_.GetFullNeighbor(ids, static_cast<int>(n),
                                                 etypes, static_cast<int>(net),
@@ -389,6 +413,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* etypes = r.Arr<int32_t>(&net);
       int32_t k = r.I32();
       uint64_t def = r.U64();
+      if (r.ok()) feed(ids, n);
       if (OversizedResult(3LL * n * std::max<int32_t>(k, 0), reply))
         return;
       size_t total = static_cast<size_t>(n) * std::max<int32_t>(k, 0);
@@ -409,6 +434,7 @@ void Service::Dispatch(const char* req, size_t len,
       const uint64_t* ids = r.Arr<uint64_t>(&n);
       const int32_t* fids = r.Arr<int32_t>(&nf);
       const int32_t* dims = r.Arr<int32_t>(&nd);
+      if (r.ok()) feed(ids, n);
       int64_t row = 0;
       for (int64_t k = 0; k < nd; ++k) row += dims[k];
       // bound row before multiplying: corrupt dims could overflow n*row
@@ -429,6 +455,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* types = r.Arr<int32_t>(&n3);
       const int32_t* fids = r.Arr<int32_t>(&nf);
       const int32_t* dims = r.Arr<int32_t>(&nd);
+      if (r.ok()) feed(src, n);
       int64_t row = 0;
       for (int64_t k = 0; k < nd; ++k) row += dims[k];
       if (OversizedResult(row, reply)) return;
@@ -445,6 +472,7 @@ void Service::Dispatch(const char* req, size_t len,
       int64_t n, nf;
       const uint64_t* ids = r.Arr<uint64_t>(&n);
       const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok()) feed(ids, n);
       if (r.ok())
         WriteResult(&w, engine_.GetSparseFeature(ids, static_cast<int>(n),
                                                  fids, static_cast<int>(nf)));
@@ -456,6 +484,7 @@ void Service::Dispatch(const char* req, size_t len,
       const uint64_t* dst = r.Arr<uint64_t>(&n2);
       const int32_t* types = r.Arr<int32_t>(&n3);
       const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok()) feed(src, n);
       if (r.ok() && n == n2 && n == n3)
         WriteResult(&w, engine_.GetEdgeSparseFeature(
                             src, dst, types, static_cast<int>(n), fids,
@@ -466,6 +495,7 @@ void Service::Dispatch(const char* req, size_t len,
       int64_t n, nf;
       const uint64_t* ids = r.Arr<uint64_t>(&n);
       const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok()) feed(ids, n);
       if (r.ok())
         WriteResult(&w, engine_.GetBinaryFeature(ids, static_cast<int>(n),
                                                  fids, static_cast<int>(nf)));
@@ -477,6 +507,7 @@ void Service::Dispatch(const char* req, size_t len,
       const uint64_t* dst = r.Arr<uint64_t>(&n2);
       const int32_t* types = r.Arr<int32_t>(&n3);
       const int32_t* fids = r.Arr<int32_t>(&nf);
+      if (r.ok()) feed(src, n);
       if (r.ok() && n == n2 && n == n3)
         WriteResult(&w, engine_.GetEdgeBinaryFeature(
                             src, dst, types, static_cast<int>(n), fids,

@@ -3,6 +3,7 @@
 #include <algorithm>
 
 #include "eg_blackbox.h"
+#include "eg_heat.h"
 #include "eg_phase.h"
 #include "eg_stats.h"
 
@@ -226,6 +227,9 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
   // map, so every surface downstream of this dump — metrics_text,
   // snapshot, the STATS scrape, metrics_dump — sees them for free
   PhaseStats::Global().HistJsonInto(&o, &first);
+  // per-op shards-touched value histograms (eg_heat.h) ride the same
+  // map for the same reason — keys heat_spread:<op>
+  Heat::Global().SpreadJsonInto(&o, &first);
   o.push_back('}');
 
   // process resource gauges (eg_blackbox.h): RSS / open fds / live
@@ -234,6 +238,12 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
   // them up with zero new plumbing (and a postmortem's frozen values
   // can be compared against what the live surfaces showed)
   Blackbox::Global().ResourceJsonInto(&o);
+
+  // data-plane heat (eg_heat.h): hot-vertex top-K, sketch totals,
+  // per-op ids ledger, fan-out attribution, cache-efficacy classes —
+  // one section in the same dump, so the whole surface chain
+  // (metrics_text/snapshot/STATS scrape/metrics_dump) inherits it
+  Heat::Global().JsonInto(&o);
 
   if (g) {
     o.push_back(',');

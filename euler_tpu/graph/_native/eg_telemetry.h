@@ -68,8 +68,8 @@ const char* const kHistKindNames[kHistKindCount] = {
 const bool kHistKindPerOp[kHistKindCount] = {true, true, false, false,
                                              false};
 
-// Per-op cell slots: wire ops 1..18 plus slot 0 for out-of-range ops.
-constexpr int kHistOpSlots = 19;
+// Per-op cell slots: wire ops 1..19 plus slot 0 for out-of-range ops.
+constexpr int kHistOpSlots = 20;
 
 // Fixed-order wire-op names (index == WireOp value; slot 0 = unknown).
 const char* const kWireOpNames[kHistOpSlots] = {
@@ -82,7 +82,7 @@ const char* const kWireOpNames[kHistOpSlots] = {
     "edge_sparse_feature", "binary_feature",
     "edge_binary_feature", "node_weight",
     "sample_neighbor_uniq", "stats",
-    "history",
+    "history",        "heat",
 };
 
 enum SpanSide : uint8_t { kSpanClient = 0, kSpanServer = 1 };

@@ -89,6 +89,12 @@ enum WireOp : uint8_t {
   // bytes} plus a fresh sample — the live view of exactly what a
   // postmortem dump freezes. Request: no args. Reply: [Str json].
   kHistory = 18,
+  // Data-plane heat scrape (eg_heat.h): the shard's full hot-vertex
+  // top-K table, count-min sketch totals, per-op ids ledger, and
+  // cache-efficacy classes — the targeted form of the heat section
+  // that also rides every kStats reply. Request: no args.
+  // Reply: [Str json].
+  kHeat = 19,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap

@@ -132,6 +132,8 @@ class Graph:
         wire_version: int | None = None,
         telemetry: bool | None = None,
         slow_spans: int | None = None,
+        heat: bool | None = None,
+        heat_topk: int | None = None,
         blackbox: bool | None = None,
         postmortem_dir: str | None = None,
         cache_dir: str | None = None,
@@ -152,8 +154,8 @@ class Graph:
             "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
             "fault_seed", "feature_cache_mb", "strict", "coalesce",
             "chunk_ids", "dispatch_workers", "wire_version", "telemetry",
-            "slow_spans", "blackbox", "postmortem_dir", "cache_dir",
-            "stream", "init",
+            "slow_spans", "heat", "heat_topk", "blackbox",
+            "postmortem_dir", "cache_dir", "stream", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -222,6 +224,13 @@ class Graph:
         if isinstance(telemetry, str):
             telemetry = str2bool(telemetry)
         slow_spans = pick("slow_spans", slow_spans, None)
+        # data-plane heat profiler (eg_heat.h; process-global like
+        # telemetry=): heat=0 stops id feeds / fan-out attribution /
+        # cache-class recording, heat_topk= resizes the hot-key tracker
+        heat = pick("heat", heat, None)
+        if isinstance(heat, str):
+            heat = str2bool(heat)
+        heat_topk = pick("heat_topk", heat_topk, None)
         # blackbox flight recorder + postmortem dump path
         # (eg_blackbox.h; process-global like telemetry=, but valid in
         # BOTH modes — an embedded-engine trainer crashes too, and its
@@ -270,6 +279,7 @@ class Graph:
                 ("dispatch_workers", dispatch_workers),
                 ("wire_version", wire_version),
                 ("telemetry", telemetry), ("slow_spans", slow_spans),
+                ("heat", heat), ("heat_topk", heat_topk),
             ):
                 if val is not None:
                     raise ValueError(
@@ -309,8 +319,8 @@ class Graph:
             feature_cache_mb=feature_cache_mb, strict=strict,
             coalesce=coalesce, chunk_ids=chunk_ids,
             dispatch_workers=dispatch_workers, wire_version=wire_version,
-            telemetry=telemetry, slow_spans=slow_spans,
-            cache_dir=cache_dir, stream=bool(stream),
+            telemetry=telemetry, slow_spans=slow_spans, heat=heat,
+            heat_topk=heat_topk, cache_dir=cache_dir, stream=bool(stream),
         )
         self.mode = mode
         self._strict = bool(strict) if strict is not None else False
@@ -438,6 +448,10 @@ class Graph:
                 conf += f";telemetry={1 if p['telemetry'] else 0}"
             if p["slow_spans"] is not None:
                 conf += f";slow_spans={int(p['slow_spans'])}"
+            if p["heat"] is not None:
+                conf += f";heat={1 if p['heat'] else 0}"
+            if p["heat_topk"] is not None:
+                conf += f";heat_topk={int(p['heat_topk'])}"
             if p["fault"] is not None:
                 # ';' is the k=v separator, so the fault grammar uses ','
                 # between failpoints (FAULTS.md)

@@ -92,6 +92,14 @@ def lib() -> ctypes.CDLL:
     )
     _sig(L.eg_remote_scrape, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
     _sig(L.eg_remote_history, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
+    _sig(L.eg_heat_enabled, c.c_int, [])
+    _sig(L.eg_heat_set_enabled, None, [c.c_int])
+    _sig(L.eg_heat_set_topk, None, [c.c_int])
+    _sig(L.eg_heat_record, None, [c.c_int, c.c_int, u64p, c.c_int64])
+    _sig(L.eg_heat_estimate, c.c_uint64, [c.c_int, c.c_uint64])
+    _sig(L.eg_heat_json, c.c_int, [c.c_char_p, c.c_int])
+    _sig(L.eg_heat_reset, None, [])
+    _sig(L.eg_remote_heat, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
     _sig(L.eg_blackbox_enabled, c.c_int, [])
     _sig(L.eg_blackbox_set_enabled, None, [c.c_int])
     _sig(L.eg_blackbox_init, c.c_int, [c.c_char_p, c.c_int, c.c_int])

@@ -60,7 +60,7 @@ class GraphService:
         # admission spec (eg_admission.h): the common knobs get kwargs,
         # the long tail (max_conns, io_timeout_ms, idle_timeout_ms,
         # linger_ms, drain_ms, wire_version, telemetry, slow_spans,
-        # blackbox, postmortem_dir) rides in options=
+        # blackbox, heat, heat_topk, postmortem_dir) rides in options=
         opts = []
         if workers is not None:
             opts.append(f"workers={int(workers)}")
@@ -149,7 +149,7 @@ def main() -> None:
     ap.add_argument("--options", default=None, help=(
         "extra k=v;k=v admission options (max_conns, io_timeout_ms, "
         "idle_timeout_ms, linger_ms, drain_ms, wire_version, telemetry, "
-        "slow_spans, blackbox, postmortem_dir — see "
+        "slow_spans, blackbox, heat, heat_topk, postmortem_dir — see "
         "eg_admission.h)"))
     ap.add_argument("--postmortem_dir", default=None, help=(
         "arm the fatal-signal postmortem path: on SIGSEGV/SIGBUS/"

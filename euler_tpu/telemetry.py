@@ -248,6 +248,10 @@ _HIST_FAMILIES = {
     "prefetch_busy": ("eg_prefetch_workers_busy",
                       "Prefetch workers inside make_batch at consumer "
                       "dequeue (value histogram)", "op"),
+    "heat_spread": ("eg_heat_shard_spread",
+                    "Shards touched per client call (value histogram "
+                    "per op — data-plane heat fan-out attribution)",
+                    "op"),
 }
 
 _GAUGE_FAMILIES = {
@@ -355,6 +359,63 @@ def _render(sources: list) -> str:
                 emitted_header = True
             lines.append(
                 f"{fam}{_fmt_labels(dict(base))} {resource[rkey]}"
+            )
+
+    # data-plane heat (eg_heat.h "heat" section): per-(side, op) id
+    # feeds, cache-efficacy classes, and the top-K concentration
+    # headline — nonzero series only, headers always (dashboards before
+    # traffic)
+    lines.append("# HELP eg_heat_ids_total Vertex ids fed to the heat "
+                 "profiler per side and op (client: post-coalesce; "
+                 "server: pre-execute)")
+    lines.append("# TYPE eg_heat_ids_total counter")
+    for data, base in sources:
+        heat = data.get("heat")
+        if not heat:
+            continue
+        for key, v in sorted(heat["ids"].items()):
+            side, _, op = key.partition(":")
+            labels = dict(base)
+            labels["side"] = side
+            labels["op"] = op
+            lines.append(f"eg_heat_ids_total{_fmt_labels(labels)} {v}")
+    lines.append("# HELP eg_heat_cache_class_total Feature-cache events "
+                 "bucketed by the key's sketch-estimated frequency class "
+                 "(class c covers estimates in [2^(c-1), 2^c))")
+    lines.append("# TYPE eg_heat_cache_class_total counter")
+    for data, base in sources:
+        heat = data.get("heat")
+        if not heat:
+            continue
+        for event, classes in sorted(heat["cache_class"].items()):
+            for cls, v in enumerate(classes):
+                if not v:
+                    continue
+                labels = dict(base)
+                labels["event"] = event
+                labels["class"] = str(cls)
+                lines.append(
+                    f"eg_heat_cache_class_total{_fmt_labels(labels)} {v}"
+                )
+    lines.append("# HELP eg_heat_topk_share Share of the side's access "
+                 "stream absorbed by its tracked top-K hot ids")
+    lines.append("# TYPE eg_heat_topk_share gauge")
+    for data, base in sources:
+        heat = data.get("heat")
+        if not heat:
+            continue
+        for side in ("client", "server"):
+            total = heat["sketch"]["total"].get(side, 0)
+            if not total:
+                continue
+            share = min(
+                1.0,
+                sum(e["count"] for e in heat["topk"][side]) / total,
+            )
+            labels = dict(base)
+            labels["side"] = side
+            lines.append(
+                f"eg_heat_topk_share{_fmt_labels(labels)} {share:.6f}"
             )
 
     return "\n".join(lines) + "\n"

@@ -13,10 +13,11 @@ STATS wire opcode (eg_telemetry), and prints per shard:
   * non-zero counters (FAULTS.md glossary);
   * the shard's slowest spans with their trace ids.
 
-With `--watch N` it re-scrapes every N seconds and prints DELTA columns
-(requests served, counter movement) next to the live gauges — the
-at-a-glance view for watching a rolling restart or a load drill without
-a Prometheus stack. A transiently unreachable shard (mid-restart,
+With `--watch N` it re-scrapes every N seconds and prints per-interval
+RATES (requests served /s, counter movement /s) next to the live
+gauges — the at-a-glance view for watching a rolling restart or a load
+drill without a Prometheus stack; `--raw` restores raw cumulative
+counter values. A transiently unreachable shard (mid-restart,
 crashed, draining) is skipped-and-noted, never aborts the watch; its
 deltas resume from the last good scrape once it answers again. Step-phase histograms (OBSERVABILITY.md "Step
 phases") print whenever a scraped process has recorded any — shard
@@ -114,11 +115,14 @@ def _served_total(data: dict) -> int:
 
 
 def watch_cluster(graph, every_s: float, iterations: int | None = None,
-                  out=sys.stdout) -> None:
-    """Re-scrape every `every_s` seconds, printing per-shard DELTAS
-    (requests served, counter movement) next to the live admission
-    gauges. iterations=None runs until interrupted (the CLI); tests
-    pass a bound."""
+                  out=sys.stdout, raw: bool = False) -> None:
+    """Re-scrape every `every_s` seconds, printing per-shard RATES
+    (requests served /s and counter movement /s over the interval since
+    the shard's last good scrape) next to the live admission gauges —
+    a cumulative counter's absolute value says nothing at a glance; its
+    rate is what an operator watches move. `raw=True` (--raw) prints
+    the raw cumulative counter values instead. iterations=None runs
+    until interrupted (the CLI); tests pass a bound."""
     from euler_tpu import telemetry as T
 
     prev: dict = {}
@@ -135,7 +139,7 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
                 # a transiently unreachable shard is ROUTINE during a
                 # rolling restart (DEPLOY.md drill): skip-and-note, keep
                 # watching the rest — the watch must outlive the blip.
-                # prev[s] is kept, so deltas resume from the last good
+                # prev[s] is kept, so rates resume from the last good
                 # scrape when the shard comes back.
                 unreachable.add(s)
                 print(f"[{stamp}] shard {s}: unreachable — skipped "
@@ -144,9 +148,11 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
             if s in unreachable:
                 unreachable.discard(s)
                 print(f"[{stamp}] shard {s}: reachable again", file=out)
+            now = time.monotonic()
             served = _served_total(data)
             ctr = {k: v for k, v in data["counters"].items() if v}
             last = prev.get(s, {})
+            dt = now - last.get("t", now)
             d_served = served - last.get("served", 0)
             d_ctr = {
                 k: v - last.get("ctr", {}).get(k, 0)
@@ -154,17 +160,33 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
             }
             d_ctr = {k: v for k, v in d_ctr.items() if v}
             g = data.get("gauges", {})
-            line = (f"[{stamp}] shard {s}: served +{d_served} "
+            line = (f"[{stamp}] shard {s}: served +{d_served}"
+                    f"{_rate(d_served, dt)} "
                     f"busy {g.get('workers_active', '?')} "
                     f"queue {g.get('queue_depth', '?')} "
                     f"conns {g.get('conns', '?')} "
                     f"draining {g.get('draining', '?')}")
-            if d_ctr:
-                line += f"  Δcounters {d_ctr}"
+            if raw:
+                if ctr:
+                    line += f"  counters {ctr}"
+            elif d_ctr:
+                rates = {
+                    k: round(v / dt, 1) if dt > 0 else float(v)
+                    for k, v in d_ctr.items()
+                }
+                line += f"  Δcounters/s {rates}"
             print(line, file=out)
-            prev[s] = {"served": served, "ctr": ctr}
+            prev[s] = {"served": served, "ctr": ctr, "t": now}
         out.flush()
         n += 1
+
+
+def _rate(delta: int, dt: float) -> str:
+    """Render ' (N/s)' for a per-interval delta; empty on the first
+    scrape of a shard (no interval to rate over yet)."""
+    if dt <= 0:
+        return ""
+    return f" ({delta / dt:.1f}/s)"
 
 
 def run_smoke() -> int:
@@ -219,17 +241,22 @@ def run_smoke() -> int:
             # client side saw every op too
             spans = T.slow_spans()
             assert spans and any(s["side"] == "client" for s in spans)
-            # the --watch delta path against the same live cluster
+            # the --watch rate path against the same live cluster
             # (after the parity pins — watching adds scrape traffic):
-            # two iterations with traffic in between must show movement
+            # a second interval must carry /s rates (the first scrape
+            # of a shard has no interval to rate over), and --raw must
+            # fall back to cumulative counter values
             import io
 
             buf = io.StringIO()
-            watch_cluster(g, 0.05, iterations=1, out=buf)
-            g.sample_node(16, -1)
-            watch_cluster(g, 0.05, iterations=1, out=buf)
+            watch_cluster(g, 0.05, iterations=2, out=buf)
             watch_out = buf.getvalue()
             assert "served +" in watch_out, watch_out
+            assert "/s)" in watch_out, watch_out
+            buf_raw = io.StringIO()
+            watch_cluster(g, 0.05, iterations=1, out=buf_raw, raw=True)
+            raw_out = buf_raw.getvalue()
+            assert "Δcounters/s" not in raw_out, raw_out
             print("metrics_dump smoke: OK")
             return 0
         finally:
@@ -250,9 +277,12 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable: one JSON array of shard dumps")
     ap.add_argument("--watch", type=float, default=0.0, metavar="N", help=(
-        "re-scrape every N seconds, printing per-shard deltas "
-        "(requests served, counter movement) next to the live gauges; "
-        "Ctrl-C stops"))
+        "re-scrape every N seconds, printing per-shard RATES (requests "
+        "served /s, counter movement /s over each interval) next to "
+        "the live gauges; Ctrl-C stops"))
+    ap.add_argument("--raw", action="store_true", help=(
+        "with --watch: print raw cumulative counter values instead of "
+        "per-interval rates"))
     ap.add_argument("--iterations", type=int, default=None,
                     help=argparse.SUPPRESS)  # bounds --watch (tests)
     ap.add_argument("--smoke", action="store_true", help=(
@@ -278,7 +308,8 @@ def main() -> int:
     try:
         if args.watch > 0:
             try:
-                watch_cluster(g, args.watch, iterations=args.iterations)
+                watch_cluster(g, args.watch, iterations=args.iterations,
+                              raw=args.raw)
             except KeyboardInterrupt:
                 pass
         else:

@@ -225,6 +225,50 @@ def bench_config(reg: str, steps: int, batch: int, fanouts,
     }
 
 
+def heat_ab_paired(reg: str, pairs: int, steps: int, batch: int, fanouts,
+                   feature_dim: int) -> dict:
+    """Paired interleaved heat on/off measurement on ONE client against
+    the running cluster: per pair, both arms run back-to-back (order
+    alternating), and the per-pair relative wall difference is the
+    sample. Single-shot A/B draws scatter +-4pp on the 1-core container
+    (box drift between configs lands entirely in the difference);
+    pairing cancels the drift, so the median here is the number the <2%
+    overhead contract is judged on (PERF.md "Data-plane heat")."""
+    import statistics
+
+    import euler_tpu
+    from euler_tpu.heat import set_heat
+
+    g = euler_tpu.Graph(mode="remote", registry=reg)
+    try:
+        run_workload(g, 2, batch, fanouts, feature_dim)  # warm
+        diffs = []
+        for pair in range(pairs):
+            walls = {}
+            arms = [True, False] if pair % 2 == 0 else [False, True]
+            for flag in arms:
+                set_heat(flag)
+                t0 = time.perf_counter()
+                run_workload(g, steps, batch, fanouts, feature_dim)
+                walls[flag] = time.perf_counter() - t0
+            diffs.append(
+                (walls[True] - walls[False]) / walls[False] * 100.0
+            )
+        diffs.sort()
+        return {
+            "pairs": pairs,
+            "steps_per_arm": steps,
+            "median_overhead_pct": round(statistics.median(diffs), 2),
+            "mean_overhead_pct": round(statistics.mean(diffs), 2),
+            "sem_pct": round(
+                statistics.stdev(diffs) / len(diffs) ** 0.5, 2
+            ) if len(diffs) > 1 else 0.0,
+        }
+    finally:
+        set_heat(True)
+        g.close()
+
+
 def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
                      steps: int | None = None) -> dict:
     """Full before/after measurement; returns the bench-driver-shaped
@@ -283,6 +327,26 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
             (tel_off["edges_per_sec"] - after["edges_per_sec"])
             / tel_off["edges_per_sec"] * 100.0, 2,
         ) if tel_off["edges_per_sec"] > 0 else 0.0
+        # HEAT A/B: the optimized path with ONLY the data-plane heat
+        # profiler off (telemetry/blackbox stay on), so the sketch +
+        # top-K + fan-out recording is priced on its own under the same
+        # <2% contract (PERF.md "Data-plane heat"). heat= is
+        # process-global, so the in-process shards stop feeding too;
+        # re-enabled in the finally below.
+        heat_off = bench_config(
+            reg, steps, batch, fanouts, feature_dim, "heat_off",
+            heat=False,
+        )
+        heat_overhead_pct = round(
+            (heat_off["edges_per_sec"] - after["edges_per_sec"])
+            / heat_off["edges_per_sec"] * 100.0, 2,
+        ) if heat_off["edges_per_sec"] > 0 else 0.0
+        # the statistically sound form: paired interleaved arms cancel
+        # the box drift a single-shot config comparison cannot
+        heat_ab = heat_ab_paired(
+            reg, pairs=3 if smoke else 10, steps=max(2, steps // 2),
+            batch=batch, fanouts=fanouts, feature_dim=feature_dim,
+        )
         reduction = (
             after["ids_requested"] / after["ids_on_wire"]
             if after["ids_on_wire"] > 0 else float("inf")
@@ -309,6 +373,9 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
                 "after": after,
                 "telemetry_off": tel_off,
                 "telemetry_overhead_pct": telemetry_overhead_pct,
+                "heat_off": heat_off,
+                "heat_overhead_pct": heat_overhead_pct,
+                "heat_ab": heat_ab,
                 "speedup": round(
                     after["edges_per_sec"] / before["edges_per_sec"], 3
                 ),
@@ -317,10 +384,12 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
         }
     finally:
         from euler_tpu.blackbox import set_blackbox
+        from euler_tpu.heat import set_heat
         from euler_tpu.telemetry import set_telemetry
 
-        set_telemetry(True)  # the kill-switch A/B is process-global
+        set_telemetry(True)  # the kill-switch A/Bs are process-global
         set_blackbox(True)
+        set_heat(True)
         for p in procs:
             if hasattr(p, "stop"):
                 p.stop()

@@ -61,6 +61,19 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu \
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/trace_dump.py --smoke >/dev/null || fail=1
 
+step "data-plane heat: sketch exactness + doc-drift gate + skew-report smoke (OBSERVABILITY.md 'Data-plane heat')"
+# The eg_heat access profiler: space-saving/count-min exactness pins,
+# the ids ledger identity on a live cluster, the metric-name doc-drift
+# gate (every eg_* family emitted by metrics_text() must be in the
+# OBSERVABILITY.md glossary and vice versa), then a real heat_dump skew
+# report against a 2-shard cluster — ROADMAP item 5's pre-measurement
+# instrument cannot silently rot.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_heat.py tests/test_metric_docs.py -q \
+  -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/heat_dump.py --smoke >/dev/null || fail=1
+
 step "blackbox postmortem drill (OBSERVABILITY.md 'Postmortems')"
 # The flight-recorder/crash-dump suites by name, then the incident
 # drill: a seeded crash failpoint kills a live shard, the postmortem is

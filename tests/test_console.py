@@ -85,6 +85,41 @@ def test_stats_blackbox_shows_recorder_and_resources(console, capsys):
     B.blackbox_reset()
 
 
+def test_stats_heat_shows_topk_and_ledger(console, capsys):
+    """`stats heat` prints the hot-vertex table (fed here through the
+    app-level record primitive) and the cache-class rows
+    (OBSERVABILITY.md 'Data-plane heat')."""
+    import numpy as np
+
+    from euler_tpu import heat as H
+
+    H.heat_reset()
+    H.set_heat(True)
+    H.record_heat(np.array([7, 7, 7, 8, 8, 9], dtype=np.int64),
+                  op="dense_feature")
+    console.execute("stats heat")
+    out = capsys.readouterr().out
+    assert "heat on" in out
+    assert "client top-3" in out
+    # hottest first: id 7 (count 3) leads the table
+    lines = [ln for ln in out.splitlines() if ln.strip().startswith("1 ")]
+    assert lines and " 7 " in lines[0]
+    H.heat_reset()
+
+
+def test_stats_bare_lists_subcommands(console, capsys):
+    """Bare `stats` advertises the full subcommand roster — the help
+    text stopped being updated after the telemetry PR, so this pins
+    every surface added since."""
+    console.execute("stats")
+    out = capsys.readouterr().out
+    for sub in ("hist", "phases", "slow", "blackbox", "heat", "reset"):
+        assert sub in out, (sub, out)
+    console.execute("help stats")
+    help_out = capsys.readouterr().out
+    assert "stats [hist|phases|slow|blackbox|heat|reset]" in help_out
+
+
 def test_stats_span_timers(console, capsys):
     """The native span-timer subsystem records ops and resets."""
     import euler_tpu

@@ -1,0 +1,413 @@
+"""eg_heat: the data-plane access profiler (OBSERVABILITY.md
+"Data-plane heat").
+
+Everything here is exact arithmetic: the space-saving top-K table is
+pinned against ground-truth Counter values (exactness whenever K covers
+the stream's distinct ids), the count-min estimates against the
+eps = e/width overestimate bound, the client ids ledger against the
+`ids_on_wire = ids_requested - ids_deduped - cache_hits` identity, and
+the cache-efficacy class buckets against the cache_hits/cache_misses
+counters they must sum to.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+import euler_tpu
+from euler_tpu import heat as H
+from euler_tpu import telemetry as T
+from euler_tpu.graph import native
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.service import GraphService
+from tests.fixture_graph import write_fixture
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    native.fault_clear()
+    native.reset_counters()
+    T.telemetry_reset()  # resets histograms + spans + phases + heat
+    T.set_telemetry(True)
+    H.set_heat(True)
+    H.set_heat_topk(128)
+    yield
+    native.fault_clear()
+    native.reset_counters()
+    T.telemetry_reset()
+    T.set_telemetry(True)
+    H.set_heat(True)
+    H.set_heat_topk(128)
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("heat_data"))
+    write_fixture(d, num_partitions=2)
+    return d
+
+
+@pytest.fixture(scope="module")
+def heavytail_dir(tmp_path_factory):
+    """A reddit_heavytail-shaped fixture at test scale: power-law
+    out-degrees with preferential targets (the datasets.REDDIT_HEAVYTAIL
+    recipe's alpha), so the access streams below have a real heavy
+    tail."""
+    from euler_tpu.datasets import build_powerlaw
+
+    d = str(tmp_path_factory.mktemp("heat_heavytail"))
+    build_powerlaw(d, num_nodes=400, num_edges=6000, feature_dim=8,
+                   label_dim=3, alpha=1.8, num_partitions=4, seed=23)
+    return d
+
+
+def _graph(svcs, **kw):
+    kw.setdefault("retries", 2)
+    kw.setdefault("timeout_ms", 5000)
+    return Graph(mode="remote", shards=[s.address for s in svcs], **kw)
+
+
+def _zipf_stream(num_ids: int, length: int, alpha: float = 1.3,
+                 seed: int = 3) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, num_ids + 1, dtype=np.float64)
+    probs = ranks ** (-alpha)
+    probs /= probs.sum()
+    return rng.choice(num_ids, size=length, p=probs).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# sketch exactness: space-saving + count-min against ground truth
+# ---------------------------------------------------------------------------
+
+
+def test_space_saving_exact_when_k_covers_distinct():
+    """With K >= the number of distinct ids, space-saving degenerates to
+    exact counting: every id tracked, counts exact, err == 0."""
+    stream = _zipf_stream(100, 5000)
+    H.record_heat(stream, op="dense_feature")
+    truth = collections.Counter(stream.tolist())
+    top = H.heat_topk()
+    assert len(top) == len(truth)
+    for e in top:
+        assert e["count"] == truth[e["id"]], e
+        assert e["err"] == 0, e
+    # hottest-first ordering
+    counts = [e["count"] for e in top]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_space_saving_bounds_beyond_capacity():
+    """K smaller than the distinct-id count: every tracked id satisfies
+    count >= true >= count - err, and every id hotter than N/K is
+    guaranteed tracked (the space-saving heavy-hitter guarantee)."""
+    H.set_heat_topk(16)
+    stream = _zipf_stream(300, 8000, alpha=1.5)
+    H.record_heat(stream)
+    truth = collections.Counter(stream.tolist())
+    top = H.heat_topk()
+    assert len(top) == 16
+    tracked = {e["id"]: e for e in top}
+    for e in top:
+        true = truth[e["id"]]
+        assert e["count"] >= true, e
+        assert e["count"] - e["err"] <= true, e
+    n = len(stream)
+    for id_, c in truth.items():
+        if c > n / 16:
+            assert id_ in tracked, (id_, c)
+
+
+def test_cms_estimates_within_epsilon(heavytail_dir):
+    """Count-min point estimates: est >= true ALWAYS (structural — the
+    sketch only ever adds), and est <= true + eps * N per query with
+    probability 1 - e^-depth (~86% at depth 2). The stream is seeded,
+    so the empirical within-budget fraction is deterministic; pinning
+    it well above the theoretical floor catches any regression in the
+    hash spreading without asserting a bound the sketch never
+    promised."""
+    # an access stream shaped by the heavytail fixture's degree skew
+    g = euler_tpu.Graph(directory=heavytail_dir)
+    _, _, _, deg = g.get_full_neighbor(np.arange(400), [0])
+    g.close()
+    rng = np.random.default_rng(7)
+    probs = deg.astype(np.float64) + 1.0
+    probs /= probs.sum()
+    stream = rng.choice(400, size=20000, p=probs).astype(np.int64)
+    H.record_heat(stream)
+    truth = collections.Counter(stream.tolist())
+    data = H.heat_json()
+    eps = H.cms_epsilon(data)
+    total = data["sketch"]["total"]["client"]
+    assert total == len(stream)
+    budget = eps * total
+    within = 0
+    for id_ in range(400):
+        est = H.estimate(id_)
+        assert est >= truth[id_], (id_, est, truth[id_])
+        if est <= truth[id_] + budget:
+            within += 1
+        else:
+            # even a colliding estimate stays a small additive error on
+            # this stream, nowhere near a hot id's count
+            assert est <= truth[id_] + 20 * budget, (id_, est, truth[id_])
+    assert within / 400 >= 0.95, (within, budget)
+
+
+def test_topk_capacity_resize_resets_tables():
+    H.record_heat(np.arange(50, dtype=np.int64))
+    assert len(H.heat_topk()) == 50
+    H.set_heat_topk(8)
+    assert H.heat_topk() == []
+    H.record_heat(np.zeros(3, dtype=np.int64))
+    top = H.heat_topk()
+    assert len(top) == 1 and top[0]["count"] == 3
+
+
+def test_kill_switches_record_nothing():
+    H.set_heat(False)
+    H.record_heat(np.arange(10, dtype=np.int64))
+    assert H.heat_topk() == []
+    assert H.heat_json()["sketch"]["total"]["client"] == 0
+    H.set_heat(True)
+    # the master telemetry switch gates heat too
+    T.set_telemetry(False)
+    H.record_heat(np.arange(10, dtype=np.int64))
+    assert H.heat_topk() == []
+    T.set_telemetry(True)
+    H.record_heat(np.arange(10, dtype=np.int64))
+    assert len(H.heat_topk()) == 10
+
+
+def test_op_name_table_matches_native():
+    """heat.OP_NAMES must mirror the native kWireOpNames slot order —
+    the ids ledger keys are built from it on the native side."""
+    H.record_heat([1, 2, 3], op="sample_neighbor")
+    H.record_heat([4], op="heat", side="server")
+    ids = H.heat_json()["ids"]
+    assert ids == {"client:sample_neighbor": 3, "server:heat": 1}
+
+
+# ---------------------------------------------------------------------------
+# live-cluster exactness: server top-K, ids ledger, cache classes
+# ---------------------------------------------------------------------------
+
+
+def test_server_topk_matches_ground_truth_on_cluster(heavytail_dir):
+    """Capstone pin: a 2-shard cluster served a deterministic
+    heavy-tailed id stream; the servers' merged top-K table must match
+    the exact per-unique-id-per-call ground truth (client coalescing
+    means each call feeds its DISTINCT ids once)."""
+    svcs = [GraphService(heavytail_dir, s, 2) for s in range(2)]
+    try:
+        g = _graph(svcs, feature_cache_mb=0)  # cache off: every unique
+        try:                                  # id reaches the servers
+            T.telemetry_reset()
+            truth: collections.Counter = collections.Counter()
+            rng = np.random.default_rng(11)
+            for step in range(6):
+                stream = _zipf_stream(400, 512, alpha=1.6,
+                                      seed=int(rng.integers(1 << 30)))
+                g.node_types(stream)
+                truth.update(set(stream.tolist()))
+            top = H.heat_topk(side="server")
+            assert top, "server table empty"
+            # K (128) covers the heavy tail here, so every tracked id
+            # hot enough to be unambiguous is EXACT
+            for e in top:
+                assert e["count"] - e["err"] <= truth[e["id"]] <= e["count"]
+            exact = [e for e in top if e["err"] == 0]
+            assert exact, top
+            for e in exact:
+                assert e["count"] == truth[e["id"]], (e, truth[e["id"]])
+            # the hottest id overall is the hottest id in truth
+            hottest_truth = max(truth.values())
+            assert top[0]["count"] >= hottest_truth
+            # the same table over the wire (kHeat) names this shard
+            d0 = H.heat_json(g, 0)
+            assert d0["shard"] == 0
+            assert d0["topk"]["server"] == H.heat_json()["topk"]["server"]
+            assert d0["conns"], d0  # requesting-conn attribution present
+        finally:
+            g.close()
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_ids_ledger_identity_and_cache_class_sums(data_dir):
+    """The acceptance identity, measured not derived: per op
+    ids_on_wire == ids_requested - ids_deduped - cache_hits, and the
+    cache-efficacy class buckets sum to the cache_hits/cache_misses
+    counters."""
+    svcs = [GraphService(data_dir, s, 2) for s in range(2)]
+    try:
+        g = _graph(svcs, feature_cache_mb=8)
+        try:
+            T.telemetry_reset()
+            native.reset_counters()
+            ids = np.array([1, 2, 3, 1, 2, 3, 4, 4, 5], dtype=np.int64)
+            g.get_dense_feature(ids, [0], [4])   # all misses
+            g.get_dense_feature(ids, [0], [4])   # all unique ids hit
+            g.sample_neighbor(ids, [0, 1], 3)
+            d = H.heat_json()
+            ctr = native.counters()
+            for op in ("dense_feature", "sample_neighbor"):
+                f = d["fanout"][op]
+                assert f["ids_on_wire"] == (f["ids_requested"]
+                                            - f["ids_deduped"]
+                                            - f["cache_hits"]), (op, f)
+            fdf = d["fanout"]["dense_feature"]
+            assert fdf["ids_requested"] == 18
+            assert fdf["ids_deduped"] == 8        # 4 dups per call
+            assert fdf["cache_hits"] == 5         # second call all-hit
+            assert fdf["cache_hits"] == ctr["cache_hits"]
+            cc = d["cache_class"]
+            assert sum(cc["hit"]) == ctr["cache_hits"]
+            assert sum(cc["miss"]) == ctr["cache_misses"]
+            # sample_neighbor never touches the cache
+            assert d["fanout"]["sample_neighbor"]["cache_hits"] == 0
+        finally:
+            g.close()
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_cache_evictions_land_in_classes(data_dir):
+    """A cache far smaller than the working set must evict, and every
+    eviction lands in a frequency-class bucket."""
+    svcs = [GraphService(data_dir, 0, 1)]
+    try:
+        # 1 MB budget across 16 stripes with ~1.1 KB rows (256 floats):
+        # ~55 rows per stripe, so 3000 distinct rows must evict
+        g = _graph(svcs, feature_cache_mb=1)
+        try:
+            T.telemetry_reset()
+            native.reset_counters()
+            for lo in range(0, 3000, 500):
+                ids = np.arange(lo, lo + 500, dtype=np.int64)
+                g.get_dense_feature(ids, [0], [256])
+            cc = H.heat_json()["cache_class"]
+            assert sum(cc["evict"]) > 0, cc
+            assert sum(cc["miss"]) == native.counters()["cache_misses"]
+        finally:
+            g.close()
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_heat_spread_and_metrics_families(data_dir):
+    """The shards-touched spread histograms ride the shared hist map
+    (count == calls), and the eg_heat_* Prometheus families render from
+    the same dump."""
+    svcs = [GraphService(data_dir, s, 2) for s in range(2)]
+    try:
+        g = _graph(svcs)
+        try:
+            T.telemetry_reset()
+            ids = np.array([10, 11, 12, 13], dtype=np.int64)
+            for _ in range(3):
+                g.sample_neighbor(ids, [0, 1], 2)
+            hist = euler_tpu.telemetry_json()["hist"]
+            key = "heat_spread:sample_neighbor"
+            assert key in hist, sorted(k for k in hist
+                                       if k.startswith("heat"))
+            assert hist[key]["count"] == 3
+            d = H.heat_json()
+            assert d["fanout"]["sample_neighbor"]["calls"] == 3
+            assert d["shard_bytes"], d  # bytes attributed per shard
+            text = euler_tpu.metrics_text()
+            assert 'eg_heat_ids_total{side="client"' in text
+            assert "eg_heat_topk_share" in text
+            assert "eg_heat_shard_spread" in text
+        finally:
+            g.close()
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# config keys
+# ---------------------------------------------------------------------------
+
+
+def test_heat_keys_rejected_on_local_graphs(data_dir):
+    with pytest.raises(ValueError, match="heat="):
+        Graph(directory=data_dir, heat=True)
+    with pytest.raises(ValueError, match="heat_topk="):
+        Graph(directory=data_dir, heat_topk=64)
+
+
+def test_heat_config_keys_reach_the_switches(data_dir):
+    svcs = [GraphService(data_dir, 0, 1)]
+    try:
+        g = _graph(svcs, heat=False, heat_topk=32)
+        try:
+            assert not H.heat_enabled()
+            ids = np.array([1, 2, 3], dtype=np.int64)
+            g.node_types(ids)
+            assert H.heat_topk() == []
+        finally:
+            g.close()
+        # service options flip it back on
+        g2 = _graph(svcs, heat=True)
+        try:
+            g2.node_types(np.array([1, 2, 3], dtype=np.int64))
+            assert H.heat_topk(side="server")
+        finally:
+            g2.close()
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_bad_heat_topk_fails_loudly(data_dir):
+    svcs = [GraphService(data_dir, 0, 1)]
+    try:
+        with pytest.raises(RuntimeError, match="heat_topk"):
+            _graph(svcs, heat_topk=1 << 20)
+        with pytest.raises(RuntimeError, match="heat_topk"):
+            GraphService(data_dir, 0, 1, options="heat_topk=0")
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_service_option_heat_kill_switch(data_dir):
+    svc = GraphService(data_dir, 0, 1, options="heat=0")
+    try:
+        assert not H.heat_enabled()
+    finally:
+        svc.stop()
+    H.set_heat(True)
+
+
+# ---------------------------------------------------------------------------
+# skew-report arithmetic (scripts/heat_dump.py helpers)
+# ---------------------------------------------------------------------------
+
+
+def test_zipf_fit_recovers_exponent():
+    counts = [int(1e6 * r ** -1.4) for r in range(1, 65)]
+    top = [{"id": i, "count": c, "err": 0} for i, c in enumerate(counts)]
+    fit = H.zipf_fit(top)
+    assert abs(fit["alpha"] - 1.4) < 0.02, fit
+    assert fit["r2"] > 0.999
+
+
+def test_cache_hit_ceiling_arithmetic():
+    # 3 ids, counts 10/5/1, total 16: pinning the top 2 yields
+    # (10-1)+(5-1) = 13 hits of 16 accesses
+    top = [{"id": 1, "count": 10, "err": 0},
+           {"id": 2, "count": 5, "err": 0},
+           {"id": 3, "count": 1, "err": 0}]
+    ce = H.cache_hit_ceiling(top, 16, 2)
+    assert ce["projected_hit_rate"] == round(13 / 16, 4)
+    # capacity beyond the table extrapolates (monotone, bounded)
+    big = H.cache_hit_ceiling(top, 16, 100)
+    assert big["projected_hit_rate"] >= ce["projected_hit_rate"]
+    assert big["projected_hit_rate"] <= 1.0

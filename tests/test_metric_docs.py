@@ -1,0 +1,112 @@
+"""Metric doc-drift gate: the OBSERVABILITY.md glossary IS the metric
+surface.
+
+PRs 5-8 each added Prometheus families (`eg_*`); nothing stopped a new
+family from shipping undocumented, or a doc entry from outliving its
+metric. This gate pins both directions against a LIVE emission:
+
+  * every family `metrics_text()` emits (local process AND a cluster
+    scrape, so the scrape-only admission gauges are covered) must
+    appear in the "## Metric glossary" section of OBSERVABILITY.md;
+  * every `eg_*` family named in that glossary section must be emitted.
+
+The glossary section is the single canonical table — families mentioned
+elsewhere in the doc (runbooks, examples) don't count as documentation;
+the table does.
+"""
+
+import pathlib
+import re
+
+import numpy as np
+import pytest
+
+import euler_tpu
+from euler_tpu import telemetry as T
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.service import GraphService
+from tests.fixture_graph import write_fixture
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("metric_docs_data"))
+    write_fixture(d, num_partitions=2)
+    return d
+
+
+def emitted_families(text: str) -> set:
+    """Family names as declared by the exposition's own HELP headers —
+    the set a Prometheus server would discover."""
+    return {
+        m.group(1)
+        for m in re.finditer(r"^# HELP (eg_[a-z0-9_]+) ", text,
+                             re.MULTILINE)
+    }
+
+
+def documented_families() -> set:
+    """eg_* tokens inside the canonical '## Metric glossary' section
+    (and only there — prose mentions elsewhere are not documentation)."""
+    doc = (REPO / "OBSERVABILITY.md").read_text()
+    m = re.search(r"^## Metric glossary$(.*?)^## ", doc,
+                  re.MULTILINE | re.DOTALL)
+    assert m, "OBSERVABILITY.md lost its '## Metric glossary' section"
+    return set(re.findall(r"\beg_[a-z0-9_]+\b", m.group(1)))
+
+
+def test_every_emitted_family_is_documented_and_vice_versa(data_dir):
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = Graph(mode="remote", shards=[svc.address], retries=2,
+                  timeout_ms=5000)
+        try:
+            T.telemetry_reset()
+            # enough traffic that every data-dependent series family
+            # (heat, cache classes, spread) has a nonzero emitter
+            ids = np.array([1, 2, 3, 1, 2], dtype=np.int64)
+            g.sample_neighbor(ids, [0, 1], 2)
+            g.get_dense_feature(ids, [0], [4])
+            g.get_dense_feature(ids, [0], [4])
+            local = emitted_families(euler_tpu.metrics_text())
+            scraped = emitted_families(euler_tpu.metrics_text(graph=g))
+            emitted = local | scraped
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+
+    documented = documented_families()
+    undocumented = sorted(emitted - documented)
+    stale = sorted(documented - emitted)
+    assert not undocumented, (
+        f"metrics_text() emits families missing from the "
+        f"OBSERVABILITY.md glossary: {undocumented} — add them to the "
+        f"'## Metric glossary' table"
+    )
+    assert not stale, (
+        f"the OBSERVABILITY.md glossary documents families "
+        f"metrics_text() no longer emits: {stale} — remove them or "
+        f"restore the metric"
+    )
+
+
+def test_gauge_families_require_the_scrape(data_dir):
+    """The admission gauges only exist in a serving process's scrape —
+    the gate above must actually be exercising that path (a local-only
+    emission would quietly shrink the covered set)."""
+    svc = GraphService(data_dir, 0, 1)
+    try:
+        g = Graph(mode="remote", shards=[svc.address], retries=2,
+                  timeout_ms=5000)
+        try:
+            local = emitted_families(euler_tpu.metrics_text())
+            scraped = emitted_families(euler_tpu.metrics_text(graph=g))
+        finally:
+            g.close()
+    finally:
+        svc.stop()
+    assert "eg_workers" in scraped
+    assert "eg_workers" not in local

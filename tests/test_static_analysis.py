@@ -42,18 +42,18 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 32, files  # all .cc and .h of _native
+    assert len(files) >= 34, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
     # dispatcher + feature cache), the server survivability layer
     # (bounded admission), the telemetry subsystem, the step-phase
-    # profiler, and the blackbox flight recorder must be under the
-    # gate, not grandfathered around it
+    # profiler, the blackbox flight recorder, and the data-plane heat
+    # profiler must be under the gate, not grandfathered around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
         "eg_cache.cc", "eg_cache.h", "eg_admission.cc", "eg_admission.h",
         "eg_telemetry.cc", "eg_telemetry.h", "eg_phase.cc", "eg_phase.h",
-        "eg_blackbox.cc", "eg_blackbox.h",
+        "eg_blackbox.cc", "eg_blackbox.h", "eg_heat.cc", "eg_heat.h",
     } <= names, names
     violations = []
     for f in files:
@@ -441,6 +441,98 @@ def test_wire_count_alloc_fires_on_postmortem_derived_count():
     )
     (v,) = only_rule(lint(snippet), "wire-count-alloc")
     assert "head" in v.message
+
+
+# ---------------------------------------------------------------------------
+# heat shapes: the data-plane access profiler (eg_heat) stays under the
+# gate — it sits on the hot path of every remote query AND inside the
+# server dispatch, exactly where these crash classes cost the most
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_heat_record_shape():
+    """The heat feed ABI runs per batch on the query hot path — a
+    guardless eg_heat_record-shaped entry point would carry a native
+    exception straight across ctypes (std::terminate)."""
+    snippet = (
+        'extern "C" {\n'
+        "void eg_heat_record(int side, int op, const uint64_t* ids,\n"
+        "                    int64_t n) {\n"
+        "  eg::Heat::Global().Record(side, op, ids, n);\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_heat_record" in v.message
+
+
+def test_raw_lock_fires_on_topk_update_shape():
+    """The space-saving tracker serializes its table under a mutex once
+    per batch; a raw lock there leaks the mutex on any early return —
+    and this update loop HAS early returns (tracked-id fast path)."""
+    snippet = (
+        "void UpdateTop(TopTable* t, uint64_t id) {\n"
+        "  t->mu.lock();\n"
+        "  if (FindSlot(*t, id) >= 0) return;\n"
+        "  t->mu.unlock();\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+def test_wire_count_alloc_fires_on_heat_table_reader_shape():
+    """A heat-scrape reader sizing its table from a wire-derived top-K
+    count is the same bound-before-alloc crash class as any wire count
+    — a malformed kHeat reply must not OOM the collector."""
+    snippet = (
+        "void ReadTopK(WireReader* r) {\n"
+        "  int64_t k = r->I64();\n"
+        "  std::vector<TopEntry> table(k);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert "k" in v.message
+
+
+def test_thread_rng_fires_on_sketch_hash_seed_shape():
+    """Sketch row seeds must come from fixed constants (or
+    eg::ThreadRng) — rand() is process-global, racy under the
+    dispatcher workers that feed the sketch concurrently, and would
+    make the count-min estimates irreproducible across runs."""
+    snippet = (
+        "uint64_t RowSeed(int d) {\n"
+        "  return static_cast<uint64_t>(rand()) * d;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-rng")
+    assert v.line == 2
+
+
+def test_ptr_arith_bounds_fires_on_cms_indexing_shape():
+    """A sketch reader bounds-checking cell offsets with the
+    overflow-prone `p + n * sizeof(T) > end` form would pass a corrupt
+    huge width and read out of the fixed cell pool."""
+    snippet = (
+        "bool CheckCells(const char* p, const char* end, int64_t width) {\n"
+        "  return p + width * sizeof(uint64_t) > end;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "ptr-arith-bounds")
+    assert v.line == 2
+
+
+def test_thread_catch_fires_on_heat_decay_thread_shape():
+    """A background decay/aging thread over the sketch (a likely future
+    extension) stays under thread-catch like every service thread — a
+    dead decay loop must freeze the sketch, not the process."""
+    snippet = (
+        "void StartDecay() {\n"
+        "  std::thread([this] { DecayLoop(); }).detach();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
 
 
 # ---------------------------------------------------------------------------
