@@ -295,17 +295,20 @@ def powerlaw_degrees(
 
 
 def _powerlaw_params(num_nodes, num_edges, feature_dim, label_dim,
-                     alpha, multilabel, num_partitions, seed) -> str:
+                     alpha, multilabel, num_partitions, seed,
+                     placement="hash") -> str:
     """The cache-identity string build_powerlaw's done marker records —
     one constructor so external gates (scripts/tpu_checks.sh's
     heavytail step) and the builder can never disagree on it."""
-    return json.dumps(
-        dict(kind="powerlaw", num_nodes=num_nodes, num_edges=num_edges,
+    d = dict(kind="powerlaw", num_nodes=num_nodes, num_edges=num_edges,
              feature_dim=feature_dim, label_dim=label_dim, alpha=alpha,
              multilabel=multilabel, num_partitions=num_partitions,
-             seed=seed, gen="unique-fill-v3-gumbel-hubs"),
-        sort_keys=True,
-    )
+             seed=seed, gen="unique-fill-v3-gumbel-hubs")
+    if placement != "hash":
+        # keyed only when non-default so every pre-PR done marker (and
+        # the tpu_checks gate's reconstruction of it) stays valid
+        d["placement"] = placement
+    return json.dumps(d, sort_keys=True)
 
 
 def heavytail_cache_dir() -> str:
@@ -362,6 +365,7 @@ def build_powerlaw(
     num_partitions: int = 4,
     seed: int = 17,
     progress_every: int = 0,
+    placement: str = "hash",
 ) -> str:
     """Heavy-tailed synthetic graph at a REAL edge budget: power-law
     out-degrees (``powerlaw_degrees``) with targets drawn preferentially
@@ -388,7 +392,7 @@ def build_powerlaw(
     os.makedirs(out_dir, exist_ok=True)
     params = _powerlaw_params(
         num_nodes, num_edges, feature_dim, label_dim, alpha, multilabel,
-        num_partitions, seed,
+        num_partitions, seed, placement,
     )
     if _cache_begin(out_dir, params):
         return out_dir
@@ -411,7 +415,17 @@ def build_powerlaw(
     }
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f)
-    outs = [
+    # placement='degree' (eg_placement.h): buffer the node dicts and let
+    # the converter's degree-aware placer route them + emit the
+    # placement artifact — trades the streaming writer's O(1) memory for
+    # the two-pass placement (fixture/bench scales; the hash default
+    # keeps streaming for reddit-scale builds)
+    if placement != "hash":
+        from euler_tpu.graph.convert import _check_placement
+
+        _check_placement(placement)
+    buffered: list | None = [] if placement != "hash" else None
+    outs = [] if buffered is not None else [
         open(os.path.join(out_dir, "part_%d.dat" % p), "wb")
         for p in range(num_partitions)
     ]
@@ -467,7 +481,10 @@ def build_powerlaw(
             "binary_feature": {},
             "edge": [],
         }
-        outs[nid % num_partitions].write(pack_block(node, meta))
+        if buffered is not None:
+            buffered.append(node)
+        else:
+            outs[nid % num_partitions].write(pack_block(node, meta))
         if progress_every and nid and nid % progress_every == 0:
             print(
                 "build_powerlaw: %d/%d nodes" % (nid, num_nodes),
@@ -475,6 +492,13 @@ def build_powerlaw(
             )
     for o in outs:
         o.close()
+    if buffered is not None:
+        from euler_tpu.graph.convert import convert_dicts
+
+        convert_dicts(
+            buffered, meta, os.path.join(out_dir, "part"),
+            num_partitions=num_partitions, placement=placement,
+        )
     _cache_finish(out_dir, params)
     return out_dir
 

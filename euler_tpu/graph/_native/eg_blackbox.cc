@@ -233,6 +233,8 @@ ResourceSample Blackbox::SampleResources() {
     std::fclose(f);
   }
   s.cache_bytes = GlobalCacheBytes().load(std::memory_order_relaxed);
+  s.nbr_cache_bytes =
+      GlobalNbrCacheBytes().load(std::memory_order_relaxed);
   return s;
 }
 
@@ -574,6 +576,9 @@ void Blackbox::ResourceJsonBody(std::string* out) {
   out->push_back(',');
   AppendKey(out, "cache_bytes");
   AppendI64(out, s.cache_bytes);
+  out->push_back(',');
+  AppendKey(out, "nbr_cache_bytes");
+  AppendI64(out, s.nbr_cache_bytes);
   out->push_back(',');
   AppendKey(out, "history_depth");
   uint64_t hh = hist_head_.load(std::memory_order_acquire);

@@ -105,6 +105,7 @@ struct ResourceSample {
   int64_t open_fds = 0;     // entries in /proc/self/fd
   int64_t threads = 0;      // /proc/self/status Threads:
   int64_t cache_bytes = 0;  // client feature-cache bytes (eg_cache.h)
+  int64_t nbr_cache_bytes = 0;  // client neighbor-list cache bytes
 };
 
 // A history-ring slot: individually-atomic fields, same reasoning as

@@ -171,6 +171,26 @@ int eg_remote_replica_count(void* h, int shard) {
   }
   EG_API_GUARD(-1)
 }
+// 1 when the remote graph routes ids through a placement map fetched at
+// init (kPlacement), 0 when it hash-routes (old server / hash-sharded
+// data / placement=0) — observability for the locality A/B and the
+// compat tests.
+int eg_remote_has_placement(void* h) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->has_placement() ? 1 : 0;
+  }
+  EG_API_GUARD(-1)
+}
+// Resolve the serving shard of each id through the client's ACTUAL
+// routing (placement map when loaded, hash fallback otherwise) — the
+// edge-cut instrument scripts/heat_dump.py measures locality with must
+// see the same routing the data plane uses, not re-derive the hash rule.
+void eg_remote_route(void* h, const uint64_t* ids, int n, int32_t* out) {
+  try {
+    static_cast<RemoteGraph*>(API(h))->RouteShards(ids, n, out);
+  }
+  EG_API_GUARD()
+}
 // Pending strict-mode failure of a remote graph (strict=1 config key):
 // copies the first recorded message into buf (NUL-terminated, truncated
 // to cap) and clears it, returning 1; 0 when nothing is pending. The

@@ -83,6 +83,39 @@ bool ReadResult(WireReader* r, EGResult* out) {
   return false;
 }
 
+// Weight-proportional draws from one adjacency slice just fetched by a
+// neighbor-cache promote (kFullNeighbor) — the client-side twin of
+// GraphStore::SampleNeighbors for the case where the slice is in hand
+// rather than cached (NeighborCache::Sample covers the cached case).
+void DrawFromSlice(const uint64_t* nid, const float* nw, const int32_t* nt,
+                   int64_t len, int64_t draws, uint64_t default_id,
+                   Rng& rng, uint64_t* out_ids, float* out_w,
+                   int32_t* out_t) {
+  std::vector<double> cum(static_cast<size_t>(len));
+  double total = 0.0;
+  for (int64_t k = 0; k < len; ++k) {
+    total += nw[k] > 0.f ? static_cast<double>(nw[k]) : 0.0;
+    cum[static_cast<size_t>(k)] = total;
+  }
+  if (total <= 0.0) {
+    for (int64_t j = 0; j < draws; ++j) {
+      out_ids[j] = default_id;
+      out_w[j] = 0.f;
+      out_t[j] = -1;
+    }
+    return;
+  }
+  for (int64_t j = 0; j < draws; ++j) {
+    double r = rng.NextDouble() * total;
+    size_t k = static_cast<size_t>(
+        std::lower_bound(cum.begin(), cum.end(), r) - cum.begin());
+    if (k >= cum.size()) k = cum.size() - 1;  // float rounding spill
+    out_ids[j] = nid[k];
+    out_w[j] = nw[k];
+    out_t[j] = nt[k];
+  }
+}
+
 // The stock error a pre-envelope (wire v1) server answers when it reads
 // the v2 envelope marker as an op code — the downgrade-negotiation
 // signal (see eg_wire.h). Matched exactly: a v2 server's genuine
@@ -522,6 +555,34 @@ bool RemoteGraph::Init(const std::string& config) {
     cache_mb = std::stoi(cfg["feature_cache_mb"]);
   if (cache_mb < 0) cache_mb = 0;
   fcache_.SetCapacity(static_cast<size_t>(cache_mb) << 20);
+  // Neighbor-list cache: hot nodes' adjacency slices sampled locally
+  // instead of per-hop wire round trips (eg_cache.h NeighborCache).
+  int nbr_mb = 16;
+  if (cfg.count("neighbor_cache_mb"))
+    nbr_mb = std::stoi(cfg["neighbor_cache_mb"]);
+  if (nbr_mb < 0) nbr_mb = 0;
+  ncache_.SetCapacity(static_cast<size_t>(nbr_mb) << 20);
+  // Shared admission policy of both caches: frequency-aware (TinyLFU
+  // shape over the heat sketch) by default, fifo restores PR-3.
+  if (cfg.count("cache_policy")) {
+    const std::string& pol = cfg["cache_policy"];
+    int policy;
+    if (pol == "freq" || pol == "lfu") {
+      policy = kCachePolicyFreq;
+    } else if (pol == "fifo") {
+      policy = kCachePolicyFifo;
+    } else {
+      error_ = "cache_policy must be 'freq' (TinyLFU-shaped admission, "
+               "the default) or 'fifo' (unconditional admission)";
+      return false;
+    }
+    fcache_.SetPolicy(policy);
+    ncache_.SetPolicy(policy);
+  }
+  // placement=0 disables the init-time map fetch (always hash-route);
+  // default 1 asks and falls back passively when no map exists.
+  if (cfg.count("placement"))
+    placement_enabled_ = std::stoi(cfg["placement"]) != 0;
 
   // Deterministic transport failpoints (eg_fault.h). Installed BEFORE the
   // per-shard kInfo fetches below, so even Init's own calls replay under
@@ -651,6 +712,42 @@ bool RemoteGraph::Init(const std::string& config) {
   if (cfg.count("num_partitions"))
     num_partitions_ = std::stoi(cfg["num_partitions"]);
   if (num_partitions_ <= 0) num_partitions_ = num_shards_;
+
+  // Placement-map fetch (eg_placement.h): one kPlacement exchange with
+  // shard 0 (every shard serves the same artifact). Negotiated
+  // passively like wire v2/v3: an old server — or a shard on
+  // hash-sharded data, which answers the identical stock error —
+  // degrades this client to hash routing (placement_fallbacks). A map
+  // that ARRIVES but is corrupt or inconsistent fails init loudly:
+  // routing by a half-trusted map would misroute silently.
+  if (placement_enabled_) {
+    WireWriter preq;
+    preq.U8(kPlacement);
+    std::string reply;
+    bool got = pools_[0].Call(preq.buf(), &reply, retries_, timeout_ms_,
+                              quarantine_ms_, backoff_ms_, deadline_ms_) &&
+               !reply.empty() &&
+               static_cast<uint8_t>(reply[0]) == kStatusOk;
+    if (got) {
+      WireReader r(reply);
+      r.U8();
+      std::string blob = r.Str();
+      if (!r.ok()) {
+        error_ = "malformed placement reply from shard 0";
+        return false;
+      }
+      if (!placement_.Parse(blob, &error_)) return false;
+      if (placement_.num_partitions() != num_partitions_) {
+        error_ = "placement map declares " +
+                 std::to_string(placement_.num_partitions()) +
+                 " partitions but the cluster reports " +
+                 std::to_string(num_partitions_);
+        return false;
+      }
+    } else {
+      Counters::Global().Add(kCtrPlacementFallback);
+    }
+  }
 
   // Aggregate weight sums + cross-shard samplers.
   node_wsum_agg_.assign(node_type_num_, 0.f);
@@ -1134,11 +1231,29 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
   // j owns reps[j] * count contiguous draws at rep_off[j] * count; each
   // original row takes the block at (rep_off[pos] + occ) * count, so
   // duplicate rows receive DISTINCT (still iid) draws.
+  //
+  // Locality split (eg_cache.h NeighborCache): each unique entry takes
+  // one of three paths —
+  //   * CACHED: its adjacency slice is in the neighbor cache; sample
+  //     locally, zero wire bytes (nbr_cache_hits);
+  //   * PROMOTE: the heat sketch marks it hot (est >=
+  //     kNbrPromoteMinFreq) — fetch its FULL slice once (kFullNeighbor),
+  //     cache it, sample locally from the fetched slice;
+  //   * FETCH: cold — the plain per-draw wire path, as before.
+  Heat& heat = Heat::Global();
+  const bool heat_on = heat.enabled();
+  const bool use_ncache = ncache_.enabled();
+  const uint64_t nspec =
+      use_ncache ? NeighborCache::SpecHash(etypes, net) : 0;
+  uint64_t nbr_hits = 0, nbr_misses = 0;
   std::vector<std::vector<int64_t>> rep_off(num_shards_);
   std::vector<std::vector<uint64_t>> sid(num_shards_);
   std::vector<std::vector<float>> sw(num_shards_);
   std::vector<std::vector<int32_t>> st(num_shards_);
   std::vector<std::vector<char>> ok(num_shards_);
+  // unique positions per shard still needing the wire, by path
+  std::vector<std::vector<int32_t>> fetch(num_shards_);
+  std::vector<std::vector<int32_t>> promote(num_shards_);
   for (int s = 0; s < num_shards_; ++s) {
     size_t m = plan.rows[s].size();
     rep_off[s].assign(m + 1, 0);
@@ -1149,18 +1264,50 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
     sw[s].assign(draws, 0.f);
     st[s].assign(draws, -1);
     ok[s].assign(m, 0);
+    if (m == 0) continue;
+    std::vector<uint64_t> sub(m);
+    for (size_t j = 0; j < m; ++j) sub[j] = ids[plan.rows[s][j]];
+    // heat feed: every unique id, post-coalesce but PRE-cache — cache
+    // hits are accesses too, and both the promotion gate and the
+    // TinyLFU admission read these estimates (this access included)
+    if (heat_on)
+      heat.Record(kHeatClient,
+                  coalesce_ ? kSampleNeighborUniq : kSampleNeighbor,
+                  sub.data(), static_cast<int64_t>(m));
+    Rng& rng = ThreadRng();
+    for (size_t j = 0; j < m; ++j) {
+      if (use_ncache) {
+        int64_t draws_j = static_cast<int64_t>(plan.reps[s][j]) * count;
+        int64_t dst = rep_off[s][j] * count;
+        if (ncache_.Sample(nspec, sub[j], static_cast<int>(draws_j),
+                           default_id, rng, sid[s].data() + dst,
+                           sw[s].data() + dst, st[s].data() + dst)) {
+          ok[s][j] = 1;
+          ++nbr_hits;
+          continue;
+        }
+        ++nbr_misses;
+        if (heat_on &&
+            heat.Estimate(kHeatClient, sub[j]) >= kNbrPromoteMinFreq) {
+          promote[s].push_back(static_cast<int32_t>(j));
+          continue;
+        }
+      }
+      fetch[s].push_back(static_cast<int32_t>(j));
+    }
   }
-  RunChunked(plan.rows, "sample_neighbor", [&](int s, int32_t b, int32_t e) {
+  Counters& ctr = Counters::Global();
+  if (nbr_hits) ctr.Add(kCtrNbrCacheHit, nbr_hits);
+  if (nbr_misses) ctr.Add(kCtrNbrCacheMiss, nbr_misses);
+  RunChunked(fetch, "sample_neighbor", [&](int s, int32_t b, int32_t e) {
     int32_t m = e - b;
     std::vector<uint64_t> sub(static_cast<size_t>(m));
     std::vector<int32_t> subreps(static_cast<size_t>(m));
-    for (int32_t j = b; j < e; ++j) {
-      sub[j - b] = ids[plan.rows[s][j]];
-      subreps[j - b] = plan.reps[s][j];
+    for (int32_t x = 0; x < m; ++x) {
+      int32_t pos = fetch[s][b + x];
+      sub[x] = ids[plan.rows[s][pos]];
+      subreps[x] = plan.reps[s][pos];
     }
-    Heat::Global().Record(
-        kHeatClient, coalesce_ ? kSampleNeighborUniq : kSampleNeighbor,
-        sub.data(), static_cast<int64_t>(sub.size()));
     WireWriter req;
     if (coalesce_) {
       // dedup'd form: each unique id once, with its repeat count
@@ -1185,31 +1332,101 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
     const uint64_t* rid = r.Arr<uint64_t>(&mi);
     const float* rw = r.Arr<float>(&mw);
     const int32_t* rt = r.Arr<int32_t>(&mt);
-    int64_t want = (rep_off[s][e] - rep_off[s][b]) * count;
+    int64_t want = 0;
+    for (int32_t x = 0; x < m; ++x)
+      want += static_cast<int64_t>(subreps[x]) * count;
     if (!r.ok() || mi != want || mw != want || mt != want) return false;
-    int64_t dst = rep_off[s][b] * count;
-    std::copy(rid, rid + want, sid[s].begin() + dst);
-    std::copy(rw, rw + want, sw[s].begin() + dst);
-    std::copy(rt, rt + want, st[s].begin() + dst);
-    for (int32_t j = b; j < e; ++j) ok[s][j] = 1;
+    // the fetched entries are a subset of the unique list, so their
+    // reply blocks scatter per entry (no contiguous rep_off range)
+    int64_t src = 0;
+    for (int32_t x = 0; x < m; ++x) {
+      int32_t pos = fetch[s][b + x];
+      int64_t draws_x = static_cast<int64_t>(subreps[x]) * count;
+      int64_t dst = rep_off[s][pos] * count;
+      std::copy(rid + src, rid + src + draws_x, sid[s].begin() + dst);
+      std::copy(rw + src, rw + src + draws_x, sw[s].begin() + dst);
+      std::copy(rt + src, rt + src + draws_x, st[s].begin() + dst);
+      ok[s][pos] = 1;
+      src += draws_x;
+    }
     return true;
   });
+  if (use_ncache) {
+    RunChunked(
+        promote, "sample_neighbor", [&](int s, int32_t b, int32_t e) {
+          int32_t m = e - b;
+          std::vector<uint64_t> sub(static_cast<size_t>(m));
+          for (int32_t x = 0; x < m; ++x)
+            sub[x] = ids[plan.rows[s][promote[s][b + x]]];
+          WireWriter req;
+          req.U8(kFullNeighbor);
+          req.Arr(sub);
+          req.Arr(etypes, net);
+          req.U8(0);
+          std::string reply;
+          if (!Call(s, req.buf(), &reply)) return false;
+          Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
+          WireReader r(reply);
+          r.U8();
+          EGResult res;
+          if (!ReadResult(&r, &res)) return false;
+          if (res.i32.size() != 2 || res.u64.size() != 1 ||
+              res.f32.size() != 1 ||
+              res.i32[1].size() != static_cast<size_t>(m))
+            return false;
+          int64_t want = 0;
+          for (int32_t x = 0; x < m; ++x) {
+            if (res.i32[1][x] < 0) return false;
+            want += res.i32[1][x];
+          }
+          if (res.u64[0].size() != static_cast<size_t>(want) ||
+              res.f32[0].size() != static_cast<size_t>(want) ||
+              res.i32[0].size() != static_cast<size_t>(want))
+            return false;
+          Rng& rng = ThreadRng();
+          int64_t off = 0;
+          for (int32_t x = 0; x < m; ++x) {
+            int32_t pos = promote[s][b + x];
+            int64_t len = res.i32[1][x];
+            const uint64_t* nid = res.u64[0].data() + off;
+            const float* nw = res.f32[0].data() + off;
+            const int32_t* nt = res.i32[0].data() + off;
+            // cache the slice for every later call (TinyLFU admission
+            // may still refuse it — the draws below don't depend on
+            // that verdict, the slice is in hand either way)
+            ncache_.Put(nspec, sub[x], nid, nw, nt,
+                        static_cast<size_t>(len));
+            int64_t draws_x =
+                static_cast<int64_t>(plan.reps[s][pos]) * count;
+            int64_t dst = rep_off[s][pos] * count;
+            DrawFromSlice(nid, nw, nt, len, draws_x, default_id, rng,
+                          sid[s].data() + dst, sw[s].data() + dst,
+                          st[s].data() + dst);
+            ok[s][pos] = 1;
+            off += len;
+          }
+          return true;
+        });
+  }
   // fan-out attribution (eg_heat.h): ids_on_wire MEASURED as the sum of
-  // the per-shard unique lists, so the heat surface's ledger identity
-  // (ids_on_wire == ids_requested - ids_deduped - cache_hits) is a real
-  // cross-check of the coalescing plan, not a restatement
-  if (Heat::Global().enabled()) {
-    uint64_t uniq = 0;
+  // the per-shard fetch + promote lists (what was actually encoded), so
+  // the heat surface's ledger identity (ids_on_wire == ids_requested -
+  // ids_deduped - cache_hits) is a real cross-check of the coalescing
+  // plan AND the neighbor cache, not a restatement. cache_hits here are
+  // NEIGHBOR-cache hits (locally sampled entries).
+  if (heat_on) {
+    uint64_t on_wire = 0;
     int touched = 0;
-    for (int s = 0; s < num_shards_; ++s)
-      if (!plan.rows[s].empty()) {
+    for (int s = 0; s < num_shards_; ++s) {
+      uint64_t wire_s = fetch[s].size() + promote[s].size();
+      if (wire_s) {
         ++touched;
-        uniq += plan.rows[s].size();
+        on_wire += wire_s;
       }
-    Heat::Global().RecordFanout(kSampleNeighbor,
-                                static_cast<uint64_t>(n),
-                                static_cast<uint64_t>(plan.coalesced), 0,
-                                uniq, touched);
+    }
+    heat.RecordFanout(kSampleNeighbor, static_cast<uint64_t>(n),
+                      static_cast<uint64_t>(plan.coalesced), nbr_hits,
+                      on_wire, touched);
   }
   for (int i = 0; i < n; ++i) {
     int s = plan.shard_of[i];

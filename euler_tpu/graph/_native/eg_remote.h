@@ -45,6 +45,7 @@
 #include "eg_cache.h"
 #include "eg_dispatch.h"
 #include "eg_engine.h"
+#include "eg_placement.h"
 #include "eg_sampling.h"
 #include "eg_wire.h"
 
@@ -154,6 +155,21 @@ class RemoteGraph : public GraphAPI {
   //     A/B baseline),
   //   feature_cache_mb (default 64; 0 = off): byte budget of the
   //     client-side dense-feature-row cache (eg_cache.h),
+  //   neighbor_cache_mb (default 16; 0 = off): byte budget of the
+  //     client-side neighbor-list cache (eg_cache.h NeighborCache):
+  //     nodes the heat sketch marks hot get their full adjacency slice
+  //     fetched once (kFullNeighbor) and every later SampleNeighbor
+  //     draw for them is served locally — distribution-identical to
+  //     the shard engine (`nbr_cache_hits`/`nbr_cache_misses`),
+  //   cache_policy (default "freq"; "fifo" restores PR-3 behavior):
+  //     admission policy of BOTH client caches — "freq" is TinyLFU-
+  //     shaped (a candidate displaces the FIFO victim only when the
+  //     heat sketch estimates it hotter; `cache_admit_rejects`),
+  //   placement (default 1; 0 = never ask): fetch the shard's
+  //     placement map at init (kPlacement) and route ids through it
+  //     (shard = map[id] % num_shards), hash fallback for unmapped ids
+  //     and for servers without a map — old servers answer the stock
+  //     unknown-op error, counted in `placement_fallbacks`,
   //   chunk_ids (default 16384): max unique ids per wire request; larger
   //     per-shard requests split into concurrent chunks (`rpc_chunks`),
   //   dispatch_workers (default 0 = auto: min(64, max(8, 2*shards))):
@@ -199,6 +215,15 @@ class RemoteGraph : public GraphAPI {
   // scripts/heat_dump.py builds its skew report from. False on
   // transport failure / bad shard index.
   bool HeatShard(int shard, std::string* json) const;
+  // True when init fetched + parsed a placement map and ids route
+  // through it (false = hash routing, the compat fallback).
+  bool has_placement() const { return placement_.loaded(); }
+  // Resolve the serving shard of each id through the SAME routing the
+  // query paths use (placement map when loaded, hash otherwise) — the
+  // observability hook scripts/heat_dump.py measures edge-cut with.
+  void RouteShards(const uint64_t* ids, int n, int32_t* out) const {
+    for (int i = 0; i < n; ++i) out[i] = ShardOf(ids[i]);
+  }
   // Pending strict-mode failure: copies + clears the first recorded
   // message. Empty string = no pending failure. (The fixed-shape query
   // ABI returns void, so strict failures surface through this side
@@ -293,7 +318,18 @@ class RemoteGraph : public GraphAPI {
   // Background poll: Discover + per-shard ConnPool::Update.
   void RediscoverLoop();
 
+  // Partition routing: the placement map (when init fetched one) names
+  // each id's partition explicitly — shard = map[id] % S, the inverse
+  // of the service's partition-ownership rule p ≡ shard (mod S) — with
+  // the hash rule as the fallback for unmapped ids and map-less
+  // clusters (old servers / hash-sharded data keep working unchanged).
   inline int ShardOf(uint64_t id) const {
+    if (placement_.loaded()) {
+      int32_t p = placement_.Lookup(id);
+      if (p >= 0)
+        return static_cast<int>(static_cast<uint64_t>(p) %
+                                static_cast<uint64_t>(num_shards_));
+    }
     return static_cast<int>((id % static_cast<uint64_t>(num_partitions_)) %
                             static_cast<uint64_t>(num_shards_));
   }
@@ -368,6 +404,12 @@ class RemoteGraph : public GraphAPI {
   // Client-side dense-feature-row cache (safe to mutate from const query
   // methods: internally striped-locked).
   mutable FeatureCache fcache_;
+  // Client-side neighbor-list cache (hot nodes' adjacency slices; same
+  // striping/mutability story as fcache_).
+  mutable NeighborCache ncache_;
+  // id -> partition routing map fetched at init (empty = hash routing).
+  PlacementMap placement_;
+  bool placement_enabled_ = true;  // placement= config key
   mutable std::mutex strict_mu_;        // guards strict_error_
   mutable std::string strict_error_;    // first pending strict failure
   // Cross-shard samplers: per type a table over shards, plus totals tables.

@@ -12,6 +12,7 @@
 #include "eg_blackbox.h"
 #include "eg_fault.h"
 #include "eg_heat.h"
+#include "eg_placement.h"
 #include "eg_registry.h"
 #include "eg_stats.h"
 #include "eg_telemetry.h"
@@ -76,6 +77,21 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
   if (!engine_.Load(data_dir, shard_idx, shard_num)) {
     error_ = engine_.error();
     return false;
+  }
+  // Placement artifact (eg_placement.h): read the blob AND parse it —
+  // a corrupt artifact must fail the shard start loudly, not surface
+  // later as client-side misrouting against whichever shards parsed it.
+  if (!ReadPlacementDir(data_dir, &placement_blob_, &error_)) return false;
+  if (!placement_blob_.empty()) {
+    PlacementMap check;
+    if (!check.Parse(placement_blob_, &error_)) return false;
+    if (check.num_partitions() != num_partitions_) {
+      error_ = "placement artifact declares " +
+               std::to_string(check.num_partitions()) +
+               " partitions but " + data_dir + " holds " +
+               std::to_string(num_partitions_) + " .dat partitions";
+      return false;
+    }
   }
   host_ = host.empty() ? "127.0.0.1" : host;
   int listen_fd = ListenTcp(host_, port, &port_);
@@ -265,6 +281,22 @@ void Service::Dispatch(const char* req, size_t len,
       // ledger — the targeted reply scripts/heat_dump.py fits its
       // Zipf tail and cache-ceiling projections from.
       w.Str(Heat::Global().Json(shard_idx_));
+      break;
+    }
+    case kPlacement: {
+      // Placement-map fetch (eg_placement.h): the raw artifact blob,
+      // verbatim. A shard serving hash-sharded data answers the STOCK
+      // unknown-op error a pre-placement server would — deliberately
+      // byte-identical, so the client's hash-routing fallback covers
+      // old servers and map-less data through one path.
+      if (placement_blob_.empty()) {
+        WireWriter e;
+        e.U8(1);
+        e.Str("unknown op " + std::to_string(op));
+        *reply = std::move(e.buf());
+        return;
+      }
+      w.Str(placement_blob_);
       break;
     }
     case kInfo: {

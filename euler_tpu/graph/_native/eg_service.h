@@ -76,6 +76,12 @@ class Service {
 
   Engine engine_;
   std::string error_;
+  // Raw placement artifact from the data dir (eg_placement.h), served
+  // verbatim through kPlacement so clients route by the same map the
+  // converter partitioned with. Empty = hash-sharded data — kPlacement
+  // then answers the stock unknown-op error, indistinguishable from a
+  // pre-placement server (one client fallback path for both).
+  std::string placement_blob_;
   std::string host_;
   int port_ = 0;
   int shard_idx_ = 0, shard_num_ = 1, num_partitions_ = 1;

@@ -144,6 +144,18 @@ enum CounterId : int {
   // the process — the exact-arithmetic anchor the blackbox tests audit
   // a dead shard's postmortem against.
   kCtrCrash,
+  // Locality ledger (eg_placement.h / eg_cache.h): how the routing and
+  // caching layers exploit access skew. nbr_cache hits/misses mirror
+  // the feature-cache pair for the client-side neighbor-list cache (a
+  // hit samples a hub hop locally — zero wire bytes, zero shard work);
+  // cache_admit_rejects counts candidates the frequency-aware (TinyLFU-
+  // shaped) admission turned away because the FIFO victim was hotter;
+  // placement_fallbacks counts clients that asked for a placement map
+  // and degraded to hash routing (old server or hash-sharded data).
+  kCtrNbrCacheHit,
+  kCtrNbrCacheMiss,
+  kCtrCacheAdmitReject,
+  kCtrPlacementFallback,
   kCtrCount,
 };
 
@@ -156,6 +168,8 @@ const char* const kCounterNames[kCtrCount] = {
     "busy_failovers",     "handler_timeouts", "deadline_rejects",
     "draining",           "wire_downgrades",  "prefetch_produced",
     "prefetch_dropped",   "prefetch_worker_errors", "crashes",
+    "nbr_cache_hits",     "nbr_cache_misses",
+    "cache_admit_rejects", "placement_fallbacks",
 };
 
 class Counters {

@@ -68,8 +68,8 @@ const char* const kHistKindNames[kHistKindCount] = {
 const bool kHistKindPerOp[kHistKindCount] = {true, true, false, false,
                                              false};
 
-// Per-op cell slots: wire ops 1..19 plus slot 0 for out-of-range ops.
-constexpr int kHistOpSlots = 20;
+// Per-op cell slots: wire ops 1..20 plus slot 0 for out-of-range ops.
+constexpr int kHistOpSlots = 21;
 
 // Fixed-order wire-op names (index == WireOp value; slot 0 = unknown).
 const char* const kWireOpNames[kHistOpSlots] = {
@@ -83,6 +83,7 @@ const char* const kWireOpNames[kHistOpSlots] = {
     "edge_binary_feature", "node_weight",
     "sample_neighbor_uniq", "stats",
     "history",        "heat",
+    "placement",
 };
 
 enum SpanSide : uint8_t { kSpanClient = 0, kSpanServer = 1 };

@@ -95,6 +95,15 @@ enum WireOp : uint8_t {
   // that also rides every kStats reply. Request: no args.
   // Reply: [Str json].
   kHeat = 19,
+  // Placement-map fetch (eg_placement.h): the raw id -> partition
+  // artifact the degree-aware converter emitted next to this shard's
+  // .dat partitions, so clients can route hub neighborhoods to the
+  // shard that actually holds them. Request: no args. Reply:
+  // [Str blob]. A shard serving hash-sharded data (no artifact)
+  // answers the STOCK "unknown op 20" error — byte-identical to a
+  // genuine pre-placement server, so one client fallback path (degrade
+  // to hash routing) covers old servers and old data alike.
+  kPlacement = 20,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap

@@ -4,7 +4,13 @@ Produces the same length-prefixed block format as the reference tooling
 (format spec derived from /root/reference/euler/tools/json2dat.py:40-175 and
 the Java partitioned converter tools/graph_data_parser/GraphDataParser.java:85),
 so fixtures and datasets interoperate in both directions. Partitioning follows
-the reference convention: node_id % num_partitions -> ``<prefix>_<p>.dat``.
+the reference convention by default: node_id % num_partitions ->
+``<prefix>_<p>.dat``. ``placement='degree'`` swaps in a greedy
+degree-descending placer that co-locates hub vertices with their sampled
+neighborhoods under a balance cap and emits a compact
+``<prefix>.placement`` artifact (id -> partition; format in
+eg_placement.h) that shards serve to clients over the kPlacement wire op
+— the locality-aware half of ROADMAP item 5 (PERF.md "Locality").
 
 Input: one JSON object per line::
 
@@ -27,6 +33,12 @@ from __future__ import annotations
 import json
 import struct
 from typing import IO
+
+# Balance slack of the degree-aware partitioner: no partition may hold
+# more than ceil(slack * N / P) nodes, so locality can never collapse
+# every hub neighborhood into one shard (the load-balance half of the
+# GNNSampler/FastSample trade-off).
+PLACEMENT_SLACK = 1.2
 
 
 def _pack_features(record: dict, slot_nums: dict[str, int]) -> bytes:
@@ -124,33 +136,178 @@ def pack_block(node: dict, meta: dict) -> bytes:
     )
 
 
+def degree_placement(
+    nodes: list[dict],
+    num_partitions: int,
+    slack: float = PLACEMENT_SLACK,
+) -> dict[int, int]:
+    """Greedy degree-descending placement: node_id -> partition.
+
+    Hubs (highest total degree: out-edges plus the in-edges the reverse
+    adjacency reveals) are placed first and spread across partitions by
+    load; every later node lands in the partition where the most of its
+    already-placed neighborhood edge mass lives, under the balance cap
+    ceil(slack * N / P). On power-law graphs this co-locates each
+    low-degree node with the hub(s) it points at, which is where nearly
+    all of its sampled hops go — the edge-cut win hash sharding cannot
+    see (GNNSampler arXiv:2108.11571, FastSample arXiv:2311.17847).
+    """
+    # adjacency as (neighbor, weight) in BOTH directions: a node's
+    # sampled hops follow its out-edges, but a hub's affinity must also
+    # count the many nodes pointing AT it
+    adj: dict[int, list[tuple[int, float]]] = {}
+    degree: dict[int, float] = {}
+    for node in nodes:
+        u = int(node["node_id"])
+        adj.setdefault(u, [])
+        degree.setdefault(u, 0.0)
+        for group in (node.get("neighbor", {}) or {}).values():
+            for dst, w in (group or {}).items():
+                v, w = int(dst), float(w)
+                adj[u].append((v, w))
+                adj.setdefault(v, []).append((u, w))
+                degree[u] = degree.get(u, 0.0) + w
+                degree[v] = degree.get(v, 0.0) + w
+    order = sorted(
+        (int(n["node_id"]) for n in nodes),
+        key=lambda u: (-degree.get(u, 0.0), u),
+    )
+    n_nodes = len(nodes)
+    cap = max(1, -(-int(n_nodes * slack) // num_partitions))
+    load = [0] * num_partitions
+    placed: dict[int, int] = {}
+    for u in order:
+        score = [0.0] * num_partitions
+        for v, w in adj.get(u, ()):
+            p = placed.get(v)
+            if p is not None:
+                score[p] += w
+        best, best_key = -1, None
+        for p in range(num_partitions):
+            if load[p] >= cap:
+                continue
+            key = (score[p], -load[p])  # affinity first, then balance
+            if best_key is None or key > best_key:
+                best, best_key = p, key
+        if best < 0:  # every partition at cap (slack rounding): spill
+            best = min(range(num_partitions), key=lambda p: load[p])
+        placed[u] = best
+        load[best] += 1
+    return placed
+
+
+def write_placement(
+    path: str, placed: dict[int, int], num_partitions: int
+) -> None:
+    """Serialize a placement map into the compact artifact the shards
+    serve (kPlacement) and clients route by — format pinned by the
+    native parser (eg_placement.h): ``EGP1 | i32 P | i64 count |
+    u64 ids[count] | i32 parts[count]``, little-endian."""
+    import numpy as np
+
+    ids = np.fromiter(placed.keys(), dtype=np.int64,
+                      count=len(placed)).view(np.uint64)
+    parts = np.fromiter(placed.values(), dtype=np.int32, count=len(placed))
+    order = np.argsort(ids)
+    with open(path, "wb") as f:
+        f.write(b"EGP1")
+        f.write(struct.pack("<iq", num_partitions, len(placed)))
+        f.write(ids[order].tobytes())
+        f.write(parts[order].tobytes())
+
+
+def _check_partitions(num_partitions: int) -> None:
+    if num_partitions < 1:
+        raise ValueError(
+            f"num_partitions must be >= 1, got {num_partitions} (0 or "
+            "negative would write no .dat files at all)"
+        )
+
+
+def _check_placement(placement: str) -> None:
+    if placement not in ("hash", "degree"):
+        raise ValueError(
+            f"placement must be 'hash' (node_id % P, the default) or "
+            f"'degree' (greedy hub co-location + placement artifact), "
+            f"got {placement!r}"
+        )
+
+
+def _write_partitions(
+    nodes: list[dict],
+    meta: dict,
+    output_prefix: str,
+    num_partitions: int,
+    placement: str,
+) -> list[str]:
+    """Shared writer: route every node block to its partition (hash or
+    placement map), rejecting duplicate node_ids LOUDLY — a duplicate
+    would silently overwrite the row in whichever partition wins, and
+    under placement routing could even land the two copies on different
+    shards."""
+    placed = (
+        degree_placement(nodes, num_partitions)
+        if placement == "degree"
+        else None
+    )
+    paths = ["%s_%d.dat" % (output_prefix, p) for p in range(num_partitions)]
+    outs: list[IO[bytes]] = [open(p, "wb") for p in paths]
+    seen: set[int] = set()
+    try:
+        for node in nodes:
+            nid = int(node["node_id"])
+            if nid in seen:
+                raise ValueError(
+                    f"duplicate node_id {nid} in input — each node must "
+                    "appear exactly once (a duplicate would overwrite "
+                    "the earlier row in whichever partition wins)"
+                )
+            seen.add(nid)
+            p = placed[nid] if placed is not None else nid % num_partitions
+            outs[p].write(pack_block(node, meta))
+    finally:
+        for o in outs:
+            o.close()
+    if placed is not None:
+        write_placement(
+            output_prefix + ".placement", placed, num_partitions
+        )
+    return paths
+
+
 def convert(
     meta_path: str,
     input_path: str,
     output_prefix: str,
     num_partitions: int = 1,
+    placement: str = "hash",
 ) -> list[str]:
     """Convert a JSON-lines graph into ``num_partitions`` .dat files.
 
+    ``placement='degree'`` replaces hash partitioning with the greedy
+    degree-descending placement (hub neighborhoods co-located under a
+    balance cap) and writes the ``<prefix>.placement`` artifact next to
+    the partitions; shards serve it and clients route by it
+    (eg_placement.h). The whole graph is held in memory for the
+    placement pass — for hash partitioning too, since duplicate-id
+    validation needs the full id set anyway and fixture-scale inputs
+    dominate this path.
+
     Returns the list of written partition paths.
     """
+    _check_partitions(num_partitions)
+    _check_placement(placement)
     with open(meta_path) as f:
         meta = json.load(f)
-    paths = ["%s_%d.dat" % (output_prefix, p) for p in range(num_partitions)]
-    outs: list[IO[bytes]] = [open(p, "wb") for p in paths]
-    try:
-        with open(input_path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                node = json.loads(line)
-                p = int(node["node_id"]) % num_partitions
-                outs[p].write(pack_block(node, meta))
-    finally:
-        for o in outs:
-            o.close()
-    return paths
+    nodes = []
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                nodes.append(json.loads(line))
+    return _write_partitions(
+        nodes, meta, output_prefix, num_partitions, placement
+    )
 
 
 def convert_dicts(
@@ -158,19 +315,15 @@ def convert_dicts(
     meta: dict,
     output_prefix: str,
     num_partitions: int = 1,
+    placement: str = "hash",
 ) -> list[str]:
     """Like :func:`convert` but from in-memory dicts (used by tests and the
     synthetic benchmark generator)."""
-    paths = ["%s_%d.dat" % (output_prefix, p) for p in range(num_partitions)]
-    outs = [open(p, "wb") for p in paths]
-    try:
-        for node in nodes:
-            p = int(node["node_id"]) % num_partitions
-            outs[p].write(pack_block(node, meta))
-    finally:
-        for o in outs:
-            o.close()
-    return paths
+    _check_partitions(num_partitions)
+    _check_placement(placement)
+    return _write_partitions(
+        nodes, meta, output_prefix, num_partitions, placement
+    )
 
 
 def main() -> None:
@@ -181,8 +334,16 @@ def main() -> None:
     ap.add_argument("input", help="JSON-lines graph path")
     ap.add_argument("output_prefix", help="output prefix; writes <prefix>_<p>.dat")
     ap.add_argument("--partitions", type=int, default=1)
+    ap.add_argument("--placement", choices=("hash", "degree"),
+                    default="hash", help=(
+                        "partitioning rule: 'hash' = node_id %% P "
+                        "(reference convention); 'degree' = greedy hub "
+                        "co-location + a <prefix>.placement artifact "
+                        "shards serve to clients (locality-aware "
+                        "routing, ROADMAP item 5)"))
     args = ap.parse_args()
-    for p in convert(args.meta, args.input, args.output_prefix, args.partitions):
+    for p in convert(args.meta, args.input, args.output_prefix,
+                     args.partitions, placement=args.placement):
         print(p)
 
 

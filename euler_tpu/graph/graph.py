@@ -125,6 +125,9 @@ class Graph:
         fault: str | None = None,
         fault_seed: int | None = None,
         feature_cache_mb: int | None = None,
+        neighbor_cache_mb: int | None = None,
+        cache_policy: str | None = None,
+        placement: bool | None = None,
         strict: bool | None = None,
         coalesce: bool | None = None,
         chunk_ids: int | None = None,
@@ -152,7 +155,8 @@ class Graph:
             "directory", "files", "shard_idx", "shard_num", "mode",
             "registry", "shards", "retries", "timeout_ms", "quarantine_ms",
             "rediscover_ms", "backoff_ms", "deadline_ms", "fault",
-            "fault_seed", "feature_cache_mb", "strict", "coalesce",
+            "fault_seed", "feature_cache_mb", "neighbor_cache_mb",
+            "cache_policy", "placement", "strict", "coalesce",
             "chunk_ids", "dispatch_workers", "wire_version", "telemetry",
             "slow_spans", "heat", "heat_topk", "blackbox",
             "postmortem_dir", "cache_dir", "stream", "init",
@@ -204,6 +208,20 @@ class Graph:
         # concurrent chunks, dispatch_workers (auto) sizes the
         # persistent dispatcher pool
         feature_cache_mb = pick("feature_cache_mb", feature_cache_mb, None)
+        # locality knobs (ROADMAP item 5; native defaults apply when
+        # None): neighbor_cache_mb (16; 0 off) bounds the client-side
+        # neighbor-list cache — hot nodes' adjacency slices sampled
+        # locally instead of per-hop wire trips; cache_policy
+        # ("freq"|"fifo", default freq) selects TinyLFU-shaped vs
+        # unconditional admission for BOTH client caches; placement
+        # (True) fetches the shard's id->partition map at init and
+        # routes through it, hash fallback when no map exists
+        neighbor_cache_mb = pick("neighbor_cache_mb", neighbor_cache_mb,
+                                 None)
+        cache_policy = pick("cache_policy", cache_policy, None)
+        placement = pick("placement", placement, None)
+        if isinstance(placement, str):
+            placement = str2bool(placement)
         strict = pick("strict", strict, None)
         if isinstance(strict, str):
             strict = str2bool(strict)
@@ -275,6 +293,8 @@ class Graph:
             # them would silently do nothing
             for key, val in (
                 ("feature_cache_mb", feature_cache_mb), ("strict", strict),
+                ("neighbor_cache_mb", neighbor_cache_mb),
+                ("cache_policy", cache_policy), ("placement", placement),
                 ("coalesce", coalesce), ("chunk_ids", chunk_ids),
                 ("dispatch_workers", dispatch_workers),
                 ("wire_version", wire_version),
@@ -316,7 +336,10 @@ class Graph:
             quarantine_ms=quarantine_ms, rediscover_ms=rediscover_ms,
             backoff_ms=backoff_ms, deadline_ms=deadline_ms,
             fault=fault, fault_seed=fault_seed,
-            feature_cache_mb=feature_cache_mb, strict=strict,
+            feature_cache_mb=feature_cache_mb,
+            neighbor_cache_mb=neighbor_cache_mb,
+            cache_policy=cache_policy, placement=placement,
+            strict=strict,
             coalesce=coalesce, chunk_ids=chunk_ids,
             dispatch_workers=dispatch_workers, wire_version=wire_version,
             telemetry=telemetry, slow_spans=slow_spans, heat=heat,
@@ -434,6 +457,12 @@ class Graph:
                 conf += f";deadline_ms={int(p['deadline_ms'])}"
             if p["feature_cache_mb"] is not None:
                 conf += f";feature_cache_mb={int(p['feature_cache_mb'])}"
+            if p["neighbor_cache_mb"] is not None:
+                conf += f";neighbor_cache_mb={int(p['neighbor_cache_mb'])}"
+            if p["cache_policy"] is not None:
+                conf += f";cache_policy={p['cache_policy']}"
+            if p["placement"] is not None:
+                conf += f";placement={1 if p['placement'] else 0}"
             if p["strict"] is not None:
                 conf += f";strict={1 if p['strict'] else 0}"
             if p["coalesce"] is not None:
@@ -516,6 +545,34 @@ class Graph:
         if self.mode != "remote":
             return 1
         return self._lib.eg_remote_replica_count(self._h, shard)
+
+    @property
+    def has_placement(self) -> bool:
+        """True when this remote client routes ids through a placement
+        map fetched at init (kPlacement; see convert.py's degree-aware
+        partitioner), False when it hash-routes — the compat fallback
+        against old servers and hash-sharded data."""
+        if self.mode != "remote":
+            return False
+        return self._lib.eg_remote_has_placement(self._h) == 1
+
+    def shard_of(self, ids) -> np.ndarray:
+        """Serving shard of each id through the client's ACTUAL routing
+        (placement map when loaded, hash fallback otherwise). The
+        edge-cut instrument (scripts/heat_dump.py --probe) measures
+        locality with this instead of re-deriving the hash rule, so a
+        placement-routed cluster is measured by the routing it uses."""
+        if self.mode != "remote":
+            raise ValueError(
+                "shard_of() applies to mode='remote' graphs (a local "
+                "graph has no shards to route to)"
+            )
+        arr = _ids(ids)
+        out = np.empty(len(arr), dtype=np.int32)
+        self._lib.eg_remote_route(
+            self._h, _ptr(arr, _U64P), len(arr), _ptr(out, _I32P)
+        )
+        return out
 
     def _check_strict(self):
         """Raise the pending strict-mode failure, if any. With

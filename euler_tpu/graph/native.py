@@ -121,6 +121,8 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_remote_shards, c.c_int, [p])
     _sig(L.eg_remote_partitions, c.c_int, [p])
     _sig(L.eg_remote_replica_count, c.c_int, [p, c.c_int])
+    _sig(L.eg_remote_has_placement, c.c_int, [p])
+    _sig(L.eg_remote_route, None, [p, u64p, c.c_int, i32p])
     _sig(L.eg_remote_strict_error, c.c_int, [p, c.c_char_p, c.c_int])
     _sig(
         L.eg_service_start,

@@ -46,7 +46,7 @@ OP_NAMES = (
     "sample_neighbor", "full_neighbor", "topk_neighbor", "dense_feature",
     "edge_dense_feature", "sparse_feature", "edge_sparse_feature",
     "binary_feature", "edge_binary_feature", "node_weight",
-    "sample_neighbor_uniq", "stats", "history", "heat",
+    "sample_neighbor_uniq", "stats", "history", "heat", "placement",
 )
 
 

@@ -80,6 +80,21 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "cache (remote/shared graph modes; native default 64, 0 "
         "disables). The graph is immutable after load, so cached rows "
         "never invalidate"))
+    p.add_argument("--neighbor_cache_mb", type=int, default=None, help=(
+        "byte budget (MB) of the remote client's neighbor-list cache "
+        "(remote/shared modes; native default 16, 0 disables): hot "
+        "nodes' adjacency slices are fetched once and sampled locally "
+        "— distribution-identical to the shard engine (PERF.md "
+        "'Locality')"))
+    p.add_argument("--cache_policy", default=None,
+                   choices=("freq", "fifo"), help=(
+        "admission policy of both remote client caches (native default "
+        "freq = TinyLFU-shaped over the heat sketch; fifo restores "
+        "unconditional admission)"))
+    p.add_argument("--placement", type=_str2bool, default=None, help=(
+        "fetch the cluster's placement map at init and route ids "
+        "through it, hash fallback when none exists (remote/shared "
+        "modes; native default on; see convert.py --placement degree)"))
     p.add_argument("--strict", type=_str2bool, default=False, help=(
         "remote/shared graph modes: raise when a shard call fails after "
         "all transport retries instead of silently training on "
@@ -254,11 +269,14 @@ def build_graph(args):
         )
     if args.graph_mode == "local" and (
         args.feature_cache_mb is not None or args.strict
+        or args.neighbor_cache_mb is not None
+        or args.cache_policy is not None or args.placement is not None
     ):
         raise ValueError(
-            "--feature_cache_mb/--strict need --graph_mode=remote or "
-            "shared (they configure the remote client's request path; "
-            "a local graph reads its own memory)"
+            "--feature_cache_mb/--neighbor_cache_mb/--cache_policy/"
+            "--placement/--strict need --graph_mode=remote or shared "
+            "(they configure the remote client's request path; a local "
+            "graph reads its own memory)"
         )
     if args.graph_mode == "local":
         graph = euler_tpu.Graph(
@@ -273,6 +291,9 @@ def build_graph(args):
             backoff_ms=args.backoff_ms,
             deadline_ms=args.deadline_ms,
             feature_cache_mb=args.feature_cache_mb,
+            neighbor_cache_mb=args.neighbor_cache_mb,
+            cache_policy=args.cache_policy,
+            placement=args.placement,
             strict=args.strict or None,
             fault=args.fault or None,
             fault_seed=args.fault_seed if args.fault else None,
@@ -404,6 +425,9 @@ def build_graph(args):
             backoff_ms=args.backoff_ms,
             deadline_ms=args.deadline_ms,
             feature_cache_mb=args.feature_cache_mb,
+            neighbor_cache_mb=args.neighbor_cache_mb,
+            cache_policy=args.cache_policy,
+            placement=args.placement,
             strict=args.strict or None,
             fault=args.fault or None,
             fault_seed=args.fault_seed if args.fault else None,

@@ -272,6 +272,8 @@ _RESOURCE_FAMILIES = {
     "threads": ("eg_threads", "Live OS threads"),
     "cache_bytes": ("eg_cache_bytes",
                     "Client feature-row cache resident bytes"),
+    "nbr_cache_bytes": ("eg_nbr_cache_bytes",
+                        "Client neighbor-list cache resident bytes"),
 }
 
 

@@ -64,14 +64,14 @@ PL_META = {
 }
 
 
-def build_powerlaw_fixture(directory: str, num_nodes: int, avg_degree: int,
+def powerlaw_fixture_nodes(num_nodes: int, avg_degree: int,
                            feature_dim: int, alpha: float = 1.1,
-                           seed: int = 7) -> None:
-    """Hub-heavy synthetic graph: zipf(alpha)-ranked destination draws, so
-    the first few ids soak up most edge mass (the Reddit heavy tail at
-    bench size)."""
-    import euler_tpu
-
+                           seed: int = 7) -> list:
+    """Node dicts of the hub-heavy synthetic graph: zipf(alpha)-ranked
+    destination draws, so the first few ids soak up most edge mass (the
+    Reddit heavy tail at bench size). Split from the .dat writer so the
+    locality A/B (scripts/heat_dump.py --ab-smoke) can partition ONE
+    node set two ways."""
     rng = np.random.default_rng(seed)
     # zipf-ish rank weights over destinations
     ranks = np.arange(1, num_nodes + 1, dtype=np.float64)
@@ -113,9 +113,21 @@ def build_powerlaw_fixture(directory: str, num_nodes: int, avg_degree: int,
                 ],
             }
         )
+    return nodes
+
+
+def build_powerlaw_fixture(directory: str, num_nodes: int, avg_degree: int,
+                           feature_dim: int, alpha: float = 1.1,
+                           seed: int = 7, placement: str = "hash") -> None:
+    """Partition the hub-heavy fixture into NUM_PARTITIONS .dat files
+    (placement='degree' adds the converter's placement artifact)."""
+    import euler_tpu
+
     euler_tpu.convert_dicts(
-        nodes, PL_META, os.path.join(directory, "part"),
-        num_partitions=NUM_PARTITIONS,
+        powerlaw_fixture_nodes(num_nodes, avg_degree, feature_dim, alpha,
+                               seed),
+        PL_META, os.path.join(directory, "part"),
+        num_partitions=NUM_PARTITIONS, placement=placement,
     )
 
 
@@ -214,7 +226,11 @@ def bench_config(reg: str, steps: int, batch: int, fanouts,
         ctr = native.counters()
     finally:
         g.close()
-    on_wire = requested - ctr["ids_deduped"] - ctr["cache_hits"]
+    # the PR-3 identity extended by PR 9: neighbor-cache hits are ids
+    # served locally too (a hub hop sampled from the cached slice never
+    # reaches the wire)
+    on_wire = (requested - ctr["ids_deduped"] - ctr["cache_hits"]
+               - ctr["nbr_cache_hits"])
     return {
         "label": label,
         "edges_per_sec": round(eps, 1),
@@ -300,11 +316,11 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
         procs = (_launch_shards_inproc if inproc else
                  _launch_shards_subproc)(data, reg)
 
-        # BASELINE: the pre-PR wire shape (dedup + cache off; the
+        # BASELINE: the pre-PR wire shape (dedup + BOTH caches off; the
         # dispatcher still runs — thread spawn/join cannot be re-added)
         before = bench_config(
             reg, steps, batch, fanouts, feature_dim, "baseline",
-            coalesce=False, feature_cache_mb=0,
+            coalesce=False, feature_cache_mb=0, neighbor_cache_mb=0,
         )
         # OPTIMIZED: defaults (coalesce on, cache on, telemetry on)
         after = bench_config(

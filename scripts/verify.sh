@@ -74,6 +74,17 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/heat_dump.py --smoke >/dev/null || fail=1
 
+step "locality: placement routing + frequency-aware caches + A/B smoke (PERF.md 'Locality')"
+# The ROADMAP item 5 layer: degree-aware partitioner validation, exact
+# TinyLFU admit/reject ledgers, neighbor-cache promotion arithmetic,
+# and the live hash-vs-placement A/B (edge-cut strictly down on the
+# same graph) — a silent locality regression fails verify before any
+# PR cites the edge-cut numbers.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_locality.py -q -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/heat_dump.py --ab-smoke >/dev/null || fail=1
+
 step "blackbox postmortem drill (OBSERVABILITY.md 'Postmortems')"
 # The flight-recorder/crash-dump suites by name, then the incident
 # drill: a seeded crash failpoint kills a live shard, the postmortem is

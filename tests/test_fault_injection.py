@@ -38,6 +38,10 @@ COUNTER_NAMES = {
     # postmortem ledger (PR 7): fires of the seeded crash failpoint,
     # counted before the raise so the dump's snapshot includes them
     "crashes",
+    # locality ledger (PR 9): neighbor-list cache hits/misses, TinyLFU
+    # admission rejections, and placement-map fallbacks to hash routing
+    "nbr_cache_hits", "nbr_cache_misses", "cache_admit_rejects",
+    "placement_fallbacks",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",

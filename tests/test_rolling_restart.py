@@ -139,10 +139,14 @@ def test_rolling_restart_drill_zero_failed_calls(tmp_path):
         # restarting must keep retrying until re-discovery learns the
         # new address — calls_failed == 0 is the acceptance bar
         native.reset_counters()
+        # neighbor_cache_mb=0: locally-sampled hub hops (PR 9) can hide
+        # a restarting shard so completely that zero calls ever retry —
+        # great for training, wrong for THIS drill, whose whole point
+        # is to exercise the transport recovery machinery under load
         g = euler_tpu.Graph(
             mode="remote", registry=reg, retries=40, timeout_ms=2000,
             backoff_ms=10, quarantine_ms=200, deadline_ms=90000,
-            rediscover_ms=250,
+            rediscover_ms=250, neighbor_cache_mb=0,
         )
 
         def rolling(i):

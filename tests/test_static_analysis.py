@@ -42,18 +42,21 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 34, files  # all .cc and .h of _native
+    assert len(files) >= 36, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
     # dispatcher + feature cache), the server survivability layer
     # (bounded admission), the telemetry subsystem, the step-phase
-    # profiler, the blackbox flight recorder, and the data-plane heat
-    # profiler must be under the gate, not grandfathered around it
+    # profiler, the blackbox flight recorder, the data-plane heat
+    # profiler, and the locality layer (placement routing + the
+    # frequency-aware caches) must be under the gate, not
+    # grandfathered around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
         "eg_cache.cc", "eg_cache.h", "eg_admission.cc", "eg_admission.h",
         "eg_telemetry.cc", "eg_telemetry.h", "eg_phase.cc", "eg_phase.h",
         "eg_blackbox.cc", "eg_blackbox.h", "eg_heat.cc", "eg_heat.h",
+        "eg_placement.cc", "eg_placement.h",
     } <= names, names
     violations = []
     for f in files:
@@ -529,6 +532,98 @@ def test_thread_catch_fires_on_heat_decay_thread_shape():
     snippet = (
         "void StartDecay() {\n"
         "  std::thread([this] { DecayLoop(); }).detach();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
+
+
+# ---------------------------------------------------------------------------
+# locality shapes: placement routing + the frequency-aware caches
+# (eg_placement, eg_cache NeighborCache) stay under the gate — the
+# placement parser eats wire/file bytes and the caches sit inside every
+# remote query, exactly where these crash classes cost the most
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_placement_route_shape():
+    """The routing ABI runs per probe batch (heat_dump edge-cut); a
+    guardless eg_remote_route-shaped entry point would carry a native
+    exception straight across ctypes (std::terminate)."""
+    snippet = (
+        'extern "C" {\n'
+        "void eg_remote_route(void* h, const uint64_t* ids, int n,\n"
+        "                     int32_t* out) {\n"
+        "  static_cast<RemoteGraph*>(h)->RouteShards(ids, n, out);\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_remote_route" in v.message
+
+
+def test_wire_count_alloc_fires_on_placement_parse_shape():
+    """The placement parser sizes its probe table from a blob-declared
+    count — the same bound-before-alloc crash class as any wire count;
+    a corrupt artifact must not OOM every client that fetches it."""
+    snippet = (
+        "bool Parse(WireReader* r, PlacementMap* out) {\n"
+        "  int64_t count = r->I64();\n"
+        "  std::vector<Slot> slots(count * 2);\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert "count" in v.message
+
+
+def test_raw_lock_fires_on_cache_admission_shape():
+    """The TinyLFU admission path holds the stripe mutex across the
+    victim comparison and has an early return on rejection — a raw
+    lock there leaks the stripe on exactly that return."""
+    snippet = (
+        "void Put(uint64_t key) {\n"
+        "  st.mu.lock();\n"
+        "  if (!CacheAdmit(policy_, key, victim)) return;\n"
+        "  st.mu.unlock();\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+def test_ptr_arith_bounds_fires_on_placement_blob_shape():
+    """A blob reader bounds-checking entry offsets with the
+    overflow-prone `p + n * 12 > end` form would pass a corrupt huge
+    count and read past the artifact."""
+    snippet = (
+        "bool CheckEntries(const char* p, const char* end, int64_t n) {\n"
+        "  return p + n * sizeof(Slot) > end;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "ptr-arith-bounds")
+    assert v.line == 2
+
+
+def test_thread_rng_fires_on_local_draw_shape():
+    """The neighbor cache's local sampler must draw from eg::ThreadRng
+    like the engine does — rand() is process-global, racy under the
+    dispatcher workers, and would break distribution-parity replays."""
+    snippet = (
+        "size_t DrawIndex(size_t n) {\n"
+        "  return static_cast<size_t>(rand()) % n;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-rng")
+    assert v.line == 2
+
+
+def test_thread_catch_fires_on_placement_refresh_shape():
+    """A background map-refresh thread (a likely future extension for
+    epoch'd placement) stays under thread-catch like every service
+    thread — a dead refresher must freeze the map, not the process."""
+    snippet = (
+        "void StartRefresh() {\n"
+        "  std::thread([this] { RefreshLoop(); }).detach();\n"
         "}\n"
     )
     (v,) = only_rule(lint(snippet), "thread-catch")
