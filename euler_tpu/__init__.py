@@ -57,6 +57,12 @@ from euler_tpu.heat import (
     heat_topk,
     set_heat,
 )
+from euler_tpu.serving import (
+    BusyError,
+    DeadlineError,
+    EmbedClient,
+)
+from euler_tpu.serve import EmbedServer
 
 __version__ = "0.2.0"
 
@@ -67,4 +73,5 @@ __all__ = [
     "scrape", "set_telemetry", "slow_spans", "telemetry_json",
     "telemetry_reset", "blackbox_json", "postmortem_read",
     "set_blackbox", "heat_json", "heat_topk", "heat_reset", "set_heat",
+    "EmbedServer", "EmbedClient", "BusyError", "DeadlineError",
 ]

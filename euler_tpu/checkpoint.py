@@ -60,10 +60,24 @@ class Checkpointer:
         import jax
         import orbax.checkpoint as ocp
 
+        # Loud, actionable failures instead of an orbax stack trace: a
+        # missing checkpoint names the directory and what IS there, and
+        # a tree mismatch (below) names both ends of the contract —
+        # these fire at serving startup (serve.py requires a restore),
+        # where "FileNotFoundError: .../d" helps nobody.
+        steps = sorted(self._mngr.all_steps())
         if step is None:
             step = self._mngr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.dir}")
+            if step is None:
+                raise ValueError(
+                    f"no checkpoint in {self.dir} (no saved steps — "
+                    f"train with --model_dir={self.dir} first)"
+                )
+        elif step not in steps:
+            raise ValueError(
+                f"no checkpoint for step {step} in {self.dir} "
+                f"(available steps: {steps})"
+            )
         consts = None
         if isinstance(state_like, dict) and "consts" in state_like:
             consts = state_like["consts"]
@@ -78,9 +92,17 @@ class Checkpointer:
             ),
             state_like,
         )
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract)
-        )
+        try:
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except Exception as e:
+            raise ValueError(
+                f"checkpoint at step {step} in {self.dir} does not "
+                f"match the provided state_like structure (saved with "
+                f"a different model/optimizer config?): "
+                f"{type(e).__name__}: {e}"
+            ) from e
         if consts is not None:
             restored = dict(restored)
             restored["consts"] = consts

@@ -69,6 +69,11 @@ COMMANDS = {
         "stats [hist|phases|slow|blackbox|heat|reset]",
         "stats heat",
     ),
+    "embed": (
+        "Query a running embedding server (euler_tpu.serve)",
+        "embed <host:port> <nids> [deadline_ms]",
+        'embed 127.0.0.1:9200 "1, 2, 3"  |  embed 127.0.0.1:9200 "5" 50',
+    ),
     "quit": ("Exit the console", "quit", "quit"),
 }
 
@@ -250,6 +255,28 @@ class Console:
         walks = self.graph.random_walk(nids, etypes, int(args[2]), p=p, q=q)
         for row in walks:
             print(" -> ".join(str(int(x)) for x in row))
+
+    def do_embed(self, args: list) -> None:
+        if len(args) < 2:
+            return _help(["embed"])
+        from euler_tpu.serving import BusyError, DeadlineError, EmbedClient
+
+        deadline = float(args[2]) if len(args) > 2 else None
+        client = EmbedClient(args[0])
+        try:
+            rows = client.embed(_ids(args[1]), deadline_ms=deadline)
+        except BusyError:
+            print("BUSY (server shed the request — retry with backoff)")
+            return
+        except DeadlineError:
+            print("DEADLINE (expired before dispatch)")
+            return
+        finally:
+            client.close()
+        for nid, row in zip(_ids(args[1]), rows):
+            vals = " ".join(f"{v:.6f}" for v in row[:8])
+            more = " ..." if rows.shape[1] > 8 else ""
+            print(f"{int(nid)}: [{vals}{more}]  dim={rows.shape[1]}")
 
     def do_stats(self, args: list) -> None:
         from euler_tpu.graph.native import (

@@ -724,6 +724,27 @@ void eg_phase_gauge(int which, uint64_t value) {
   EG_API_GUARD()
 }
 
+// One µs sample for serve-request phase `phase` (eg::ServePhase order,
+// mirrored by euler_tpu/telemetry.py SERVE_PHASES). Honors the
+// telemetry kill-switch; lands in the same "hist" map as everything
+// else (keys "serve:<name>"), so every scrape surface picks it up.
+void eg_serve_record(int phase, uint64_t us) {
+  try {
+    eg::PhaseStats::Global().RecordServe(phase, us);
+  }
+  EG_API_GUARD()
+}
+
+// One micro-batch device dispatch: `ids` = unique ids in the batch
+// (the "serve_batch" value histogram — count is dispatches, sum is
+// ids, their ratio the coalescing factor).
+void eg_serve_batch(uint64_t ids) {
+  try {
+    eg::PhaseStats::Global().RecordServeBatch(ids);
+  }
+  EG_API_GUARD()
+}
+
 void eg_telemetry_set_slow_capacity(int n) {
   try {
     eg::Telemetry::Global().SetSlowCapacity(n);

@@ -52,6 +52,13 @@ void PhaseStats::Reset() {
     for (auto& b : c.buckets) b.store(0, std::memory_order_relaxed);
     c.total.store(0, std::memory_order_relaxed);
   }
+  for (auto& c : serve_) {
+    for (auto& b : c.buckets) b.store(0, std::memory_order_relaxed);
+    c.total.store(0, std::memory_order_relaxed);
+  }
+  for (auto& b : serve_batch_.buckets)
+    b.store(0, std::memory_order_relaxed);
+  serve_batch_.total.store(0, std::memory_order_relaxed);
 }
 
 void PhaseStats::HistJsonInto(std::string* out, bool* first) const {
@@ -64,6 +71,13 @@ void PhaseStats::HistJsonInto(std::string* out, bool* first) const {
     AppendCell(out, first, kPrefetchGaugeKeys[g], gauges_[g].buckets,
                gauges_[g].total);
   }
+  for (int s = 0; s < kServePhaseCount; ++s) {
+    std::string key = std::string("serve:") + kServePhaseNames[s];
+    AppendCell(out, first, key.c_str(), serve_[s].buckets,
+               serve_[s].total);
+  }
+  AppendCell(out, first, kServeBatchKey, serve_batch_.buckets,
+             serve_batch_.total);
 }
 
 }  // namespace eg

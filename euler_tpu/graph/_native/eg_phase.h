@@ -64,6 +64,29 @@ const char* const kPrefetchGaugeKeys[kGaugeCount] = {
     "prefetch_depth", "prefetch_busy",
 };
 
+// Serve-request phase order (euler_tpu/serving, OBSERVABILITY.md
+// "Serve phases") — where one inference request's time goes, the
+// request-level twin of the training StepPhase above. The Python twin
+// (euler_tpu/telemetry.py SERVE_PHASES) indexes by this enum through
+// the eg_serve_record ABI, pinned by tests.
+enum ServePhase : int {
+  kServeQueueWait = 0,  // submit -> micro-batch collect (coalescing wait)
+  kServeSample,         // neighborhood sampling via the graph client
+  kServeDispatch,       // h2d + jitted forward, fenced block_until_ready
+  kServeTotal,          // submit -> reply wall (the sum check)
+  kServePhaseCount,
+};
+
+const char* const kServePhaseNames[kServePhaseCount] = {
+    "queue_wait", "sample", "dispatch", "total",
+};
+
+// Scalar hist-map key for the micro-batch size value histogram
+// (dimensionless log2 buckets: count = device dispatches, sum = unique
+// ids dispatched — their ratio is the coalescing factor the micro-
+// batcher exists to produce).
+const char kServeBatchKey[] = "serve_batch";
+
 class PhaseStats {
  public:
   static PhaseStats& Global();
@@ -88,6 +111,25 @@ class PhaseStats {
     c.total.fetch_add(value, std::memory_order_relaxed);
   }
 
+  // One µs sample for a serve-request phase (eg::ServePhase order).
+  // Same kill-switch and cost contract as Record, so `telemetry=0`
+  // leaves the serve hot path histogram-free.
+  void RecordServe(int phase, uint64_t us) {
+    if (!Telemetry::Global().enabled()) return;
+    if (phase < 0 || phase >= kServePhaseCount) return;
+    Cell& c = serve_[phase];
+    c.buckets[HistBucketOf(us)].fetch_add(1, std::memory_order_relaxed);
+    c.total.fetch_add(us, std::memory_order_relaxed);
+  }
+
+  // One micro-batch dispatch: `ids` = unique ids in the device batch.
+  void RecordServeBatch(uint64_t ids) {
+    if (!Telemetry::Global().enabled()) return;
+    Cell& c = serve_batch_;
+    c.buckets[HistBucketOf(ids)].fetch_add(1, std::memory_order_relaxed);
+    c.total.fetch_add(ids, std::memory_order_relaxed);
+  }
+
   void Reset();
 
   // Append this recorder's series to an in-progress JSON "hist" map
@@ -105,6 +147,8 @@ class PhaseStats {
 
   Cell phases_[kPhaseCount] = {};
   Cell gauges_[kGaugeCount] = {};
+  Cell serve_[kServePhaseCount] = {};
+  Cell serve_batch_ = {};
 };
 
 }  // namespace eg

@@ -156,6 +156,20 @@ enum CounterId : int {
   kCtrNbrCacheMiss,
   kCtrCacheAdmitReject,
   kCtrPlacementFallback,
+  // Serving ledger (euler_tpu/serving bumps these through the
+  // eg_counter_add ABI): how the embedding inference path admitted and
+  // shed load. serve_requests counts every submitted embed request;
+  // serve_busy_rejects counts requests the micro-batcher's bounded
+  // queue (or the frontend's connection cap) answered BUSY — the
+  // serve-side twin of busy_rejects; serve_deadline_rejects counts
+  // requests whose deadline expired before their batch dispatched
+  // (answered DEADLINE, never sent to the device); serve_batches
+  // counts device dispatches — serve_requests/serve_batches is the
+  // request-coalescing factor the micro-batcher exists to produce.
+  kCtrServeRequest,
+  kCtrServeBusyReject,
+  kCtrServeDeadlineReject,
+  kCtrServeBatch,
   kCtrCount,
 };
 
@@ -170,6 +184,8 @@ const char* const kCounterNames[kCtrCount] = {
     "prefetch_dropped",   "prefetch_worker_errors", "crashes",
     "nbr_cache_hits",     "nbr_cache_misses",
     "cache_admit_rejects", "placement_fallbacks",
+    "serve_requests",     "serve_busy_rejects",
+    "serve_deadline_rejects", "serve_batches",
 };
 
 class Counters {

@@ -79,6 +79,8 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_counter_add, None, [c.c_int, c.c_uint64])
     _sig(L.eg_phase_record, None, [c.c_int, c.c_uint64])
     _sig(L.eg_phase_gauge, None, [c.c_int, c.c_uint64])
+    _sig(L.eg_serve_record, None, [c.c_int, c.c_uint64])
+    _sig(L.eg_serve_batch, None, [c.c_uint64])
     _sig(L.eg_telemetry_enabled, c.c_int, [])
     _sig(L.eg_telemetry_set_enabled, None, [c.c_int])
     _sig(L.eg_telemetry_reset, None, [])

@@ -239,11 +239,43 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
     p.add_argument("--profile_dir", default="")
+    # serving (euler_tpu/serve.py; DEPLOY.md "Serving runbook")
+    p.add_argument("--serve_after", type=_str2bool, default=False, help=(
+        "train mode: after training saves its final checkpoint, "
+        "immediately serve it — start the embedding inference server "
+        "(euler_tpu.serve) on --serve_port and run until SIGTERM/"
+        "SIGINT, draining on the way out. Serves with the TRAINING "
+        "sampling config; `python -m euler_tpu.serve` serves an "
+        "existing checkpoint with the inference config instead"))
+    from euler_tpu.serving import add_serve_flags
+
+    add_serve_flags(p)
     # multi-process (multi-host TPU) — replaces PS/worker flags
     p.add_argument("--coordinator_addr", default="")
     p.add_argument("--num_processes", type=int, default=1)
     p.add_argument("--process_id", type=int, default=0)
     return p
+
+
+def check_serve_flags(args) -> None:
+    """Reject serve-only flags on a run that will never serve — they
+    would silently do nothing (the --stream/--fault loudness rule)."""
+    from euler_tpu.serving import serve_flag_overrides
+
+    if args.serve_after and args.mode != "train":
+        raise ValueError(
+            "--serve_after means train-then-serve and needs "
+            f"--mode=train (got --mode={args.mode}); to serve an "
+            "existing checkpoint use `python -m euler_tpu.serve`"
+        )
+    overrides = serve_flag_overrides(args)
+    if overrides and not args.serve_after:
+        raise ValueError(
+            f"serve-only flags {', '.join(overrides)} do nothing in "
+            f"--mode={args.mode} without --serve_after; add "
+            "--serve_after=1 (train, then serve the checkpoint) or use "
+            "`python -m euler_tpu.serve` against a saved --model_dir"
+        )
 
 
 def build_graph(args):
@@ -835,6 +867,7 @@ def main(argv=None) -> int:
 
     honor_jax_platforms_env()
     args = define_flags().parse_args(argv)
+    check_serve_flags(args)
     # after parse_args (so --help / usage errors stay instant) and
     # before any jax use: a wedged TPU relay would otherwise hang
     # backend init forever at 0% CPU with no traceback — fail fast with
@@ -917,6 +950,17 @@ def main(argv=None) -> int:
             )
         if args.mode == "train":
             run_train(model, graph, args, mesh)
+            if args.serve_after:
+                # train -> save -> immediately serve: the freshest
+                # checkpoint goes live without a second process or a
+                # re-parse of the data dir. Serves with the TRAINING
+                # sampling config (train_edge metapaths) — documented
+                # trade-off; `python -m euler_tpu.serve` is the
+                # inference-config path. Blocks until SIGTERM/SIGINT,
+                # then drains.
+                from euler_tpu import serve as serve_mod
+
+                serve_mod.run_serve(model, graph, args, mesh)
         elif args.mode == "evaluate":
             run_evaluate(model, graph, args, mesh)
         else:

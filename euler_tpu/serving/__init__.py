@@ -1,0 +1,100 @@
+"""Online embedding inference support (euler_tpu/serve.py).
+
+The serving stack is three small layers over the existing machinery:
+
+* :class:`MicroBatcher` — request-level coalescing: concurrent embed
+  queries merge into one padded-bucket device dispatch (flush on
+  ``max_batch`` unique ids or ``max_wait_us``), with bounded admission
+  (queue cap -> BUSY shedding, the PR-4 pattern) and per-request
+  deadline enforcement.
+* :class:`SLOTracker` — p50/p99 of served request latency against a
+  configured target.
+* :class:`EmbedFrontend` / :class:`EmbedClient` — a line-delimited JSON
+  TCP protocol carrying base64 float32 embeddings (bit-exact through
+  the wire), a live ``stats`` op, and SIGTERM-style drain.
+
+Telemetry rides the existing native hist map (keys ``serve:<phase>`` /
+``serve_batch``) and counter roster (``serve_*``), so metrics_text(),
+the STATS scrape, and scripts/metrics_dump.py pick the serving path up
+with zero new per-surface plumbing (OBSERVABILITY.md "Serve phases").
+"""
+
+from euler_tpu.serving.microbatch import (
+    BusyError,
+    DeadlineError,
+    MicroBatcher,
+)
+from euler_tpu.serving.slo import SLOTracker
+from euler_tpu.serving.frontend import EmbedClient, EmbedFrontend
+
+# Serve-only CLI flags, shared between `python -m euler_tpu.serve` and
+# run_loop's --serve_after (and used by run_loop to REJECT them in a
+# plain train run, where they would silently do nothing).
+SERVE_FLAG_DEFAULTS = {
+    "serve_host": "127.0.0.1",
+    "serve_port": 9200,
+    "serve_max_batch": 64,
+    "serve_max_wait_us": 2000,
+    "serve_queue_cap": 128,
+    "serve_slo_ms": 100.0,
+    "serve_max_conns": 64,
+    "serve_sample_cache": 65536,
+    "serve_deadline_ms": 0,
+}
+
+
+def add_serve_flags(p):
+    """Define the serving flag surface on an argparse parser (defaults
+    from SERVE_FLAG_DEFAULTS, which run_loop audits overrides against)."""
+    d = SERVE_FLAG_DEFAULTS
+    p.add_argument("--serve_host", default=d["serve_host"], help=(
+        "address the embedding frontend binds"))
+    p.add_argument("--serve_port", type=int, default=d["serve_port"],
+                   help="embedding frontend port (0 = ephemeral)")
+    p.add_argument("--serve_max_batch", type=int,
+                   default=d["serve_max_batch"], help=(
+        "unique ids per micro-batch device dispatch; concurrent "
+        "requests coalesce up to this"))
+    p.add_argument("--serve_max_wait_us", type=int,
+                   default=d["serve_max_wait_us"], help=(
+        "micro-batch flush window: a request waits at most this long "
+        "for co-batchable traffic before dispatching"))
+    p.add_argument("--serve_queue_cap", type=int,
+                   default=d["serve_queue_cap"], help=(
+        "bounded admission: requests queued beyond this are answered "
+        "BUSY (serve_busy_rejects) instead of building unbounded "
+        "latency"))
+    p.add_argument("--serve_slo_ms", type=float, default=d["serve_slo_ms"],
+                   help="latency SLO target the p50/p99 tracker reports "
+                        "against")
+    p.add_argument("--serve_max_conns", type=int,
+                   default=d["serve_max_conns"], help=(
+        "frontend connection cap; clients beyond it get one BUSY reply "
+        "and a close"))
+    p.add_argument("--serve_sample_cache", type=int,
+                   default=d["serve_sample_cache"], help=(
+        "per-id sampled-neighborhood cache entries (a served id's "
+        "neighborhood is drawn once, seeded by id, and reused — "
+        "deterministic embeddings and no repeat sampling for hot ids)"))
+    p.add_argument("--serve_deadline_ms", type=int,
+                   default=d["serve_deadline_ms"], help=(
+        "default per-request deadline; a request not dispatched within "
+        "it is answered DEADLINE (serve_deadline_rejects). 0 = none. "
+        "Clients can override per request"))
+    return p
+
+
+def serve_flag_overrides(args) -> list:
+    """Names of serve-only flags set away from their defaults — the
+    run_loop train-mode rejection list."""
+    return sorted(
+        f"--{name}" for name, default in SERVE_FLAG_DEFAULTS.items()
+        if getattr(args, name, default) != default
+    )
+
+
+__all__ = [
+    "BusyError", "DeadlineError", "MicroBatcher", "SLOTracker",
+    "EmbedClient", "EmbedFrontend", "SERVE_FLAG_DEFAULTS",
+    "add_serve_flags", "serve_flag_overrides",
+]

@@ -41,6 +41,11 @@ NUM_BUCKETS = 28
 # records by index through the eg_phase_record ABI, pinned by tests).
 PHASES = ("input_stall", "sample", "h2d", "device", "host", "step")
 
+# Serve-request phase order — MUST match eg_phase.h ServePhase (the
+# serving layer records by index through the eg_serve_record ABI,
+# pinned by tests). OBSERVABILITY.md "Serve phases".
+SERVE_PHASES = ("queue_wait", "sample", "dispatch", "total")
+
 
 def bucket_of(us: int) -> int:
     """Bucket index of a microsecond value (the Python twin of the
@@ -185,6 +190,32 @@ def record_prefetch_gauges(queue_depth: int, workers_busy: int) -> None:
     L.eg_phase_gauge(1, max(int(workers_busy), 0))
 
 
+def record_serve_phase(phase: str, us: float) -> None:
+    """One serve-request phase µs sample (euler_tpu/serving call
+    sites). Lands in the ``serve:<name>`` histogram of
+    :func:`telemetry_json`; the kill-switch is honored natively, so
+    ``telemetry=0`` leaves the serve hot path histogram-free."""
+    lib().eg_serve_record(SERVE_PHASES.index(phase), max(int(us), 0))
+
+
+def record_serve_batch(unique_ids: int) -> None:
+    """One micro-batch device dispatch: unique ids in the batch. Count
+    over the ``serve_batch`` value histogram is dispatches, sum is ids —
+    their ratio the request-coalescing factor."""
+    lib().eg_serve_batch(max(int(unique_ids), 0))
+
+
+def serve_hists(data: dict | None = None) -> dict:
+    """{phase: histogram dict} for the serve-request phases, extracted
+    from a telemetry dump (default: this process's)."""
+    data = data or telemetry_json()
+    return {
+        key.partition(":")[2]: h
+        for key, h in data["hist"].items()
+        if key.startswith("serve:")
+    }
+
+
 def phase_hists(data: dict | None = None) -> dict:
     """{phase: histogram dict} extracted from a telemetry dump
     (default: this process's)."""
@@ -252,6 +283,12 @@ _HIST_FAMILIES = {
                     "Shards touched per client call (value histogram "
                     "per op — data-plane heat fan-out attribution)",
                     "op"),
+    "serve": ("eg_serve_phase_us",
+              "Serve-request phase wall time (queue_wait/sample/"
+              "dispatch/total), microseconds", "phase"),
+    "serve_batch": ("eg_serve_batch_ids",
+                    "Unique ids per micro-batch device dispatch (value "
+                    "histogram; count = dispatches, sum = ids)", "op"),
 }
 
 _GAUGE_FAMILIES = {

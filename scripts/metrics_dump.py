@@ -94,6 +94,22 @@ def dump_cluster(graph, as_json: bool = False) -> list:
                 pct = T.percentiles(h)
                 print(f"  {ph:22s} {h['count']:8d} {pct[50]:10.1f} "
                       f"{pct[90]:10.1f} {pct[99]:10.1f}")
+        sv_rows = [
+            (key.split(":", 1)[1], h)
+            for key, h in sorted(data["hist"].items())
+            if key.startswith("serve:") and h["count"] > 0
+        ]
+        if sv_rows:
+            print(f"  {'serve':22s} {'count':>8s} {'p50_us':>10s} "
+                  f"{'p90_us':>10s} {'p99_us':>10s}")
+            for ph, h in sv_rows:
+                pct = T.percentiles(h)
+                print(f"  {ph:22s} {h['count']:8d} {pct[50]:10.1f} "
+                      f"{pct[90]:10.1f} {pct[99]:10.1f}")
+        sb = data["hist"].get("serve_batch", {})
+        if sb.get("count"):
+            print(f"  serve_batch: {sb['count']} dispatches, "
+                  f"{sb['sum_us'] / sb['count']:.1f} unique ids/dispatch")
         nonzero = {k: v for k, v in data["counters"].items() if v}
         if nonzero:
             print(f"  counters: {nonzero}")

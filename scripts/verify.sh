@@ -105,6 +105,17 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_rolling_restart.py tests/test_wire_fuzz.py -q \
   -p no:cacheprovider || fail=1
 
+step "serve: micro-batch parity + shedding + closed-loop load drill (DEPLOY.md 'Serving runbook')"
+# eg_serve: SLO math + batcher coalescing/shedding/deadline pins, the
+# bit-parity contract under concurrent mixed traffic, then the
+# closed-loop drill — 16 clients over a live 2-shard cluster, p99
+# bounded, shedding proven on a live scrape, served rows bit-identical
+# to the direct forward.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_serve.py -q -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/serve_drill.py --smoke >/dev/null || fail=1
+
 step "perf gate (scripts/perf_gate.py — strict for bench_smoke, warn-only remote)"
 # Smoke-to-smoke throughput trajectory check (PERF.md "Throughput
 # trajectory"). The host-only bench.py --smoke config now GATES verify

@@ -126,3 +126,175 @@ def test_consts_excluded_from_checkpoint(graph, tmp_path):
     restored2 = ckpt.restore(state2, 1)
     assert "consts" not in restored2
     ckpt.close()
+
+# ---- restore hardening (loud failures instead of orbax tracebacks) ----
+
+
+def test_restore_empty_dir_raises_actionable(model, graph, tmp_path):
+    import jax
+
+    from euler_tpu.checkpoint import Checkpointer
+    from euler_tpu.train import get_optimizer
+
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8), get_optimizer("adam", 0.01)
+    )
+    empty = str(tmp_path / "never_trained")
+    with pytest.raises(ValueError, match="no checkpoint in .*never_trained"):
+        Checkpointer(empty).restore(state)
+    # the message tells the operator what to do, not just what broke
+    with pytest.raises(ValueError, match="--model_dir"):
+        Checkpointer(empty).restore(state)
+
+
+def test_restore_missing_step_lists_available(model, graph, tmp_path):
+    import jax
+
+    from euler_tpu.checkpoint import Checkpointer
+    from euler_tpu.train import get_optimizer
+
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8), get_optimizer("adam", 0.01)
+    )
+    ckpt = Checkpointer(str(tmp_path / "c"))
+    ckpt.save(5, state, force=True)
+    ckpt.wait()
+    with pytest.raises(
+        ValueError, match=r"no checkpoint for step 7 .*available steps: \[5\]"
+    ):
+        ckpt.restore(state, step=7)
+    ckpt.close()
+
+
+def test_restore_structure_mismatch_raises_actionable(model, graph, tmp_path):
+    """A checkpoint saved under one model/optimizer config must fail a
+    mismatched restore with a message naming both ends of the contract,
+    not an orbax stack trace."""
+    import jax
+
+    from euler_tpu.checkpoint import Checkpointer
+    from euler_tpu.train import get_optimizer
+
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8), get_optimizer("adam", 0.01)
+    )
+    ckpt = Checkpointer(str(tmp_path / "c"))
+    ckpt.save(3, state, force=True)
+    ckpt.wait()
+    # a different architecture -> different param tree (orbax silently
+    # pads/truncates same-tree shape drift, so the loud path is keyed on
+    # tree structure, which is what a wrong --model_dir actually hits)
+    other = _parity_model("gcn")
+    state_gcn = other.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8), get_optimizer("adam", 0.01)
+    )
+    with pytest.raises(
+        ValueError, match="does not match the provided state_like structure"
+    ):
+        ckpt.restore(state_gcn, step=3)
+    ckpt.close()
+
+
+# ---- checkpoint -> forward parity in a fresh process ----
+
+# Child re-creates the graph and model from scratch (different PRNG key on
+# purpose: restore must overwrite everything that matters), restores the
+# checkpoint, and embeds the same seeded batch. Constructor kwargs are
+# duplicated in _parity_model below — keep the two in sync.
+_PARITY_CHILD = """
+import sys
+import numpy as np
+import jax
+import euler_tpu
+from euler_tpu.graph import native
+from euler_tpu.checkpoint import Checkpointer
+from euler_tpu.models import SupervisedGCN, SupervisedGraphSage
+from euler_tpu.train import get_optimizer
+
+fixture_dir, ckpt_dir, kind, out = sys.argv[1:5]
+graph = euler_tpu.Graph(directory=fixture_dir)
+if kind == "graphsage":
+    model = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]], fanouts=[3, 2],
+        dim=8, feature_idx=0, feature_dim=2, max_id=16,
+    )
+else:
+    model = SupervisedGCN(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]], dim=8,
+        max_nodes_per_hop=[16, 16], max_edges_per_hop=[64, 64],
+        feature_idx=0, feature_dim=2, max_id=16, use_id=True,
+    )
+ids = np.arange(8, dtype=np.int64)
+state = model.init_state(
+    jax.random.PRNGKey(99), graph, ids, get_optimizer("adam", 0.01)
+)
+state = Checkpointer(ckpt_dir).restore(state)
+native.lib().eg_seed(555)
+blocks = model.sample_embed(graph, ids)
+rows = jax.jit(model.make_embed_step())(state, blocks)
+np.save(out, np.asarray(jax.block_until_ready(rows)))
+"""
+
+
+def _parity_model(kind):
+    from euler_tpu.models import SupervisedGCN, SupervisedGraphSage
+
+    if kind == "graphsage":
+        return SupervisedGraphSage(
+            label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+            fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+        )
+    return SupervisedGCN(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]], dim=8,
+        max_nodes_per_hop=[16, 16], max_edges_per_hop=[64, 64],
+        feature_idx=0, feature_dim=2, max_id=16, use_id=True,
+    )
+
+
+@pytest.mark.parametrize("kind", ["graphsage", "gcn"])
+def test_fresh_process_restore_forward_parity(kind, graph, fixture_dir,
+                                              tmp_path):
+    """Params saved at step N and restored in a FRESH process must produce
+    bit-identical embeddings to the in-memory state — the serving
+    contract (serve.py loads checkpoints it never trained)."""
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    from euler_tpu.checkpoint import Checkpointer
+    from euler_tpu.graph import native
+    from euler_tpu.train import get_optimizer
+
+    model = _parity_model(kind)
+    ids = np.arange(8, dtype=np.int64)
+    state = model.init_state(
+        jax.random.PRNGKey(7), graph, ids, get_optimizer("adam", 0.01)
+    )
+    ckpt_dir = str(tmp_path / "ck")
+    ckpt = Checkpointer(ckpt_dir)
+    ckpt.save(2, state, force=True)
+    ckpt.wait()
+    ckpt.close()
+
+    # in-memory reference: same seeded sample, same jitted program shape
+    native.lib().eg_seed(555)
+    blocks = model.sample_embed(graph, ids)
+    want = np.asarray(
+        jax.block_until_ready(jax.jit(model.make_embed_step())(state, blocks))
+    )
+
+    out = str(tmp_path / "child.npy")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _PARITY_CHILD, fixture_dir, ckpt_dir, kind,
+         out],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, (
+        f"child failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    got = np.load(out)
+    assert got.dtype == want.dtype and got.shape == want.shape
+    np.testing.assert_array_equal(got, want)  # bit-identical, not allclose

@@ -42,6 +42,11 @@ COUNTER_NAMES = {
     # admission rejections, and placement-map fallbacks to hash routing
     "nbr_cache_hits", "nbr_cache_misses", "cache_admit_rejects",
     "placement_fallbacks",
+    # serving ledger (PR 11): admitted embed requests, admission sheds
+    # (batcher queue cap + frontend connection cap), deadline expiries
+    # caught before dispatch, and coalesced device dispatches
+    "serve_requests", "serve_busy_rejects", "serve_deadline_rejects",
+    "serve_batches",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",

@@ -1,0 +1,714 @@
+"""eg_serve tier-1 pins: micro-batching, shedding, deadlines, SLO math,
+serve telemetry, the TCP frontend, and the concurrent-traffic parity
+contract (served rows bit-identical to the direct forward).
+
+The EmbedServer tests run GraphSAGE over the local fixture graph; the
+storm test runs the whole stack — EmbedServer + EmbedFrontend over a
+live in-process 2-shard GraphService cluster — under 16 concurrent
+clients (scripts/serve_drill.py is the same shape as a standalone
+gate)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import native
+from euler_tpu.serving import (
+    BusyError,
+    DeadlineError,
+    MicroBatcher,
+    SLOTracker,
+)
+from tests.fixture_graph import TOPOLOGY
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    from euler_tpu.telemetry import set_telemetry, telemetry_reset
+
+    native.reset_counters()
+    telemetry_reset()
+    set_telemetry(True)
+    yield
+    native.reset_counters()
+    telemetry_reset()
+    set_telemetry(True)
+
+
+def _sage():
+    from euler_tpu.models import SupervisedGraphSage
+
+    return SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+    )
+
+
+@pytest.fixture()
+def server(graph):
+    import jax
+
+    from euler_tpu.serve import EmbedServer
+    from euler_tpu.train import get_optimizer
+
+    model = _sage()
+    state = model.init_state(
+        jax.random.PRNGKey(3), graph, np.arange(8),
+        get_optimizer("adam", 0.01),
+    )
+    srv = EmbedServer(
+        model, graph, state, max_batch=8, max_wait_us=2000,
+        queue_cap=16, slo_ms=500.0,
+    ).start()
+    yield srv
+    srv.close()
+
+
+# ---------------------------------------------------------------- SLO math
+
+
+def test_slo_tracker_exact_percentiles():
+    t = SLOTracker(target_ms=10.0, window=100)
+    for ms in range(1, 101):  # 1..100 ms
+        t.record(ms * 1000.0)
+    # nearest-rank over 1..100: p50 = 50th value, p99 = 99th
+    assert t.percentile(50) == 50.0
+    assert t.percentile(99) == 99.0
+    r = t.report()
+    assert r["count"] == 100
+    assert r["p50_ms"] == 50.0 and r["p99_ms"] == 99.0
+    assert r["violations"] == 90  # 11..100 exceed the 10ms target
+    assert r["ok"] is False
+
+
+def test_slo_tracker_window_wraps_and_ok():
+    t = SLOTracker(target_ms=100.0, window=4)
+    for us in (900e3, 900e3, 1e3, 2e3, 3e3, 4e3):
+        t.record(us)
+    # the two 900ms outliers fell out of the 4-sample window
+    r = t.report()
+    assert r["p99_ms"] == 4.0
+    assert r["ok"] is True  # window p99 under target
+    assert r["violations"] == 2  # lifetime count still remembers them
+    assert t.report()["count"] == 6
+
+
+def test_slo_tracker_empty():
+    r = SLOTracker(target_ms=5.0).report()
+    assert r == {"target_ms": 5.0, "count": 0, "p50_ms": 0.0,
+                 "p99_ms": 0.0, "violations": 0, "ok": True}
+
+
+# ----------------------------------------------------------- MicroBatcher
+
+
+def _rows_for(uids: np.ndarray) -> np.ndarray:
+    # fake embed: row i = [id, id] so scatter order is checkable
+    return np.stack([np.array([i, i], dtype=np.float32) for i in uids])
+
+
+def test_batcher_coalesces_and_dedups():
+    dispatches = []
+
+    def embed(uids):
+        dispatches.append(sorted(uids.tolist()))
+        return _rows_for(uids)
+
+    mb = MicroBatcher(embed, max_batch=8, max_wait_us=50_000,
+                      queue_cap=16).start()
+    try:
+        outs: dict = {}
+        reqs = {0: [1, 2], 1: [2, 3], 2: [3, 1, 4]}
+
+        def go(k):
+            outs[k] = mb.submit(reqs[k])
+
+        ts = [threading.Thread(target=go, args=(k,)) for k in reqs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # all three coalesced into ONE dispatch over the deduped union
+        assert dispatches == [[1, 2, 3, 4]]
+        assert native.counters()["serve_requests"] == 3
+        assert native.counters()["serve_batches"] == 1
+        for k, ids in reqs.items():
+            np.testing.assert_array_equal(
+                outs[k], _rows_for(np.array(ids))
+            )
+    finally:
+        mb.close()
+
+
+def test_batcher_flushes_on_max_batch():
+    """max_batch unique ids pending flushes immediately — no waiting
+    out the coalescing window."""
+    seen = threading.Event()
+
+    def embed(uids):
+        seen.set()
+        return _rows_for(uids)
+
+    # window is 10s: only the unique-id trigger can flush this fast
+    mb = MicroBatcher(embed, max_batch=2, max_wait_us=10_000_000,
+                      queue_cap=16).start()
+    try:
+        t = threading.Thread(target=mb.submit, args=([7, 8],))
+        t.start()
+        assert seen.wait(5.0), "batch never flushed on max_batch"
+        t.join()
+    finally:
+        mb.close()
+
+
+def test_batcher_busy_shedding_at_queue_cap():
+    entered, release = threading.Event(), threading.Event()
+
+    def embed(uids):
+        entered.set()
+        release.wait(10.0)
+        return _rows_for(uids)
+
+    mb = MicroBatcher(embed, max_batch=8, max_wait_us=0,
+                      queue_cap=1).start()
+    try:
+        t1 = threading.Thread(target=mb.submit, args=([1],))
+        t1.start()
+        assert entered.wait(5.0)  # r1 popped, dispatcher wedged
+        t2 = threading.Thread(target=mb.submit, args=([2],))
+        t2.start()
+        deadline = time.monotonic() + 5.0
+        while len(mb._queue) < 1:  # r2 queued (cap reached)
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        with pytest.raises(BusyError, match="queue at capacity"):
+            mb.submit([3])
+        assert native.counters()["serve_busy_rejects"] == 1
+        release.set()
+        t1.join()
+        t2.join()
+        # shed request never reached the device
+        assert native.counters()["serve_requests"] == 3
+        assert native.counters()["serve_batches"] == 2
+    finally:
+        release.set()
+        mb.close()
+
+
+def test_batcher_deadline_expires_before_dispatch():
+    calls = []
+
+    def embed(uids):
+        calls.append(uids.tolist())
+        return _rows_for(uids)
+
+    # long window + 1ms deadline: the request expires while coalescing
+    mb = MicroBatcher(embed, max_batch=8, max_wait_us=300_000,
+                      queue_cap=16).start()
+    try:
+        with pytest.raises(DeadlineError, match="deadline expired"):
+            mb.submit([5], deadline_ms=1.0)
+        assert native.counters()["serve_deadline_rejects"] == 1
+        assert calls == []  # never dispatched to the device
+    finally:
+        mb.close()
+
+
+def test_batcher_close_drains_queue():
+    done = []
+
+    def embed(uids):
+        time.sleep(0.01)
+        done.extend(uids.tolist())
+        return _rows_for(uids)
+
+    mb = MicroBatcher(embed, max_batch=1, max_wait_us=0,
+                      queue_cap=64).start()
+    outs = []
+    ts = [
+        threading.Thread(target=lambda i=i: outs.append(mb.submit([i])))
+        for i in range(6)
+    ]
+    for t in ts:
+        t.start()
+    time.sleep(0.005)
+    mb.close()  # must dispatch everything already admitted
+    for t in ts:
+        t.join()
+    assert sorted(done) == [0, 1, 2, 3, 4, 5]
+    assert len(outs) == 6
+    with pytest.raises(RuntimeError, match="stopped"):
+        mb.submit([9])
+
+
+def test_batcher_embed_error_propagates_to_requests():
+    def embed(uids):
+        raise ValueError("device fell over")
+
+    mb = MicroBatcher(embed, max_batch=8, max_wait_us=0,
+                      queue_cap=16).start()
+    try:
+        with pytest.raises(ValueError, match="device fell over"):
+            mb.submit([1, 2])
+    finally:
+        mb.close()
+
+
+# ------------------------------------------------- EmbedServer (+parity)
+
+
+def test_serve_parity_concurrent_mixed_traffic(server):
+    """The tentpole pin: rows served out of coalesced, deduped, padded
+    mixed-traffic batches are BIT-identical to the no-batching direct
+    forward, per id, regardless of co-batched neighbors."""
+    ids = sorted(TOPOLOGY)  # 10..16
+    direct = {i: server.embed_direct(i) for i in ids}
+    reqs = [
+        [10, 14, 12], [14], [16, 10], [11, 12, 13, 15], [12, 12, 14],
+        [16], [11, 15], [13, 10, 16],
+    ]
+    outs: list = [None] * len(reqs)
+
+    def go(k):
+        outs[k] = server.embed(reqs[k])
+
+    ts = [threading.Thread(target=go, args=(k,)) for k in range(len(reqs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for k, req in enumerate(reqs):
+        assert outs[k].shape == (len(req), 8)
+        assert outs[k].dtype == np.float32
+        for row, nid in zip(outs[k], req):
+            np.testing.assert_array_equal(row, direct[nid])
+    # coalescing actually happened
+    assert native.counters()["serve_batches"] < len(reqs)
+
+
+def test_serve_oversize_request_chunks(graph):
+    """One request with more unique ids than max_batch still serves
+    (the callback chunks across fixed-bucket dispatches) with per-row
+    parity."""
+    import jax
+
+    from euler_tpu.serve import EmbedServer
+    from euler_tpu.train import get_optimizer
+
+    model = _sage()
+    state = model.init_state(
+        jax.random.PRNGKey(3), graph, np.arange(8),
+        get_optimizer("adam", 0.01),
+    )
+    with EmbedServer(model, graph, state, max_batch=4) as srv:
+        ids = sorted(TOPOLOGY)  # 7 unique > max_batch=4
+        rows = srv.embed(ids)
+        assert rows.shape == (7, 8)
+        for row, nid in zip(rows, ids):
+            np.testing.assert_array_equal(row, srv.embed_direct(nid))
+
+
+def test_serve_stats_shape(server):
+    server.embed([1, 2, 3])
+    s = server.stats()
+    assert s["slo"]["count"] == 1
+    assert set(s["serve_phases"]) >= {"queue_wait", "sample",
+                                      "dispatch", "total"}
+    for ph in s["serve_phases"].values():
+        assert ph["count"] >= 1 and ph["p99_us"] >= ph["p50_us"] >= 0
+    assert s["counters"]["serve_requests"] == 1
+    assert s["batch"]["dispatches"] == 1
+    assert s["batch"]["mean_unique_ids"] == 3.0
+
+
+def test_serve_histograms_reach_every_surface(server):
+    """Zero-plumbing criterion: one serve request and the serve families
+    appear in telemetry_json() and metrics_text() untouched."""
+    from euler_tpu import telemetry as T
+
+    server.embed([4, 7])
+    hists = T.serve_hists()
+    assert {"queue_wait", "sample", "dispatch", "total"} <= set(hists)
+    assert all(h["count"] == 1 for h in hists.values())
+    # total >= queue_wait + dispatch in accumulated time
+    assert (hists["total"]["sum_us"]
+            >= hists["dispatch"]["sum_us"])
+    text = T.metrics_text()
+    assert "# HELP eg_serve_phase_us " in text
+    assert 'eg_serve_phase_us_count{phase="total"}' in text
+    assert "# HELP eg_serve_batch_ids " in text
+    batch = T.telemetry_json()["hist"]["serve_batch"]
+    assert batch["count"] == 1 and batch["sum_us"] == 2  # 2 unique ids
+
+
+def test_serve_kill_switch_leaves_hot_path_histogram_free(server):
+    from euler_tpu import telemetry as T
+    from euler_tpu.telemetry import set_telemetry
+
+    set_telemetry(False)
+    server.embed([1, 2])
+    assert all(h["count"] == 0 for h in T.serve_hists().values())
+    assert T.telemetry_json()["hist"]["serve_batch"]["count"] == 0
+    set_telemetry(True)
+    server.embed([1, 2])
+    assert T.serve_hists()["total"]["count"] == 1
+
+
+def test_serve_rejects_device_sampling_models(graph):
+    import jax
+
+    from euler_tpu.models import SupervisedGraphSage
+    from euler_tpu.serve import EmbedServer
+    from euler_tpu.train import get_optimizer
+
+    model = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+        device_sampling=True, device_features=True,
+    )
+    state = model.init_state(
+        jax.random.PRNGKey(0), graph, np.arange(8),
+        get_optimizer("adam", 0.01),
+    )
+    with pytest.raises(ValueError, match="device_sampling"):
+        EmbedServer(model, graph, state)
+
+
+def test_serve_sample_cache_bounded_and_deterministic(graph):
+    import jax
+
+    from euler_tpu.serve import EmbedServer
+    from euler_tpu.train import get_optimizer
+
+    model = _sage()
+    state = model.init_state(
+        jax.random.PRNGKey(3), graph, np.arange(8),
+        get_optimizer("adam", 0.01),
+    )
+    with EmbedServer(model, graph, state, max_batch=4,
+                     sample_cache=2) as srv:
+        a = srv.embed_direct(5)
+        # evict id 5, then resample it: the id-derived seed makes the
+        # fresh draw identical to the cached one
+        for nid in (1, 2, 3):
+            srv.embed_direct(nid)
+        assert len(srv._cache) == 2
+        np.testing.assert_array_equal(a, srv.embed_direct(5))
+
+
+# ----------------------------------------------------------- frontend
+
+
+def test_frontend_roundtrip_and_errors(server):
+    from euler_tpu.serving import EmbedClient, EmbedFrontend
+
+    fe = EmbedFrontend(server, port=0)
+    try:
+        c = EmbedClient(fe.address)
+        rows = c.embed([3, 6, 3])
+        assert rows.shape == (3, 8) and rows.dtype == np.float32
+        # wire is bit-exact, duplicates preserved
+        np.testing.assert_array_equal(rows[0], server.embed_direct(3))
+        np.testing.assert_array_equal(rows[0], rows[2])
+        s = c.stats()
+        assert s["ok"] and s["slo"]["count"] >= 1
+        assert c.ping() == {"ok": True, "draining": False}
+        with pytest.raises(RuntimeError, match="embed needs ids"):
+            c.embed([])
+        c.close()
+    finally:
+        fe.stop()
+
+
+def test_frontend_connection_cap_sheds_busy(server):
+    from euler_tpu.serving import EmbedClient, EmbedFrontend
+
+    fe = EmbedFrontend(server, port=0, max_conns=1)
+    try:
+        c1 = EmbedClient(fe.address)
+        assert c1.ping()["ok"]  # holds the only slot
+        c2 = EmbedClient(fe.address)
+        with pytest.raises(BusyError):
+            c2.ping()
+        assert native.counters()["serve_busy_rejects"] >= 1
+        c2.close()
+        c1.close()
+    finally:
+        fe.stop()
+
+
+def test_frontend_drain_refuses_new_connections(server):
+    from euler_tpu.serving import EmbedClient, EmbedFrontend
+
+    fe = EmbedFrontend(server, port=0)
+    addr = fe.address
+    fe.drain(grace_s=1.0)
+    with pytest.raises((ConnectionError, OSError, BusyError)):
+        EmbedClient(addr, timeout_s=2.0).ping()
+    fe.stop()
+
+
+# ----------------------------------------------------------- console
+
+
+def test_console_embed_command(server, capsys):
+    from euler_tpu.console import Console
+    from euler_tpu.serving import EmbedFrontend
+
+    fe = EmbedFrontend(server, port=0)
+    try:
+        con = Console()
+        con.execute(f'embed {fe.address} "1, 2"')
+        out = capsys.readouterr().out
+        assert "1:" in out and "2:" in out and "dim=8" in out
+    finally:
+        fe.stop()
+
+
+# ----------------------------------------------------- run_loop flags
+
+
+def test_run_loop_rejects_serve_flags_without_serve_after():
+    from euler_tpu import run_loop
+
+    p = run_loop.define_flags()
+    a = p.parse_args(["--data_dir", "/tmp/x", "--serve_slo_ms", "50"])
+    with pytest.raises(ValueError, match="--serve_slo_ms.*--serve_after"):
+        run_loop.check_serve_flags(a)
+    a = p.parse_args(["--data_dir", "/tmp/x", "--mode", "evaluate",
+                      "--serve_after", "1"])
+    with pytest.raises(ValueError, match="--mode=train"):
+        run_loop.check_serve_flags(a)
+    # clean configs pass
+    run_loop.check_serve_flags(p.parse_args(["--data_dir", "/tmp/x"]))
+    run_loop.check_serve_flags(p.parse_args(
+        ["--data_dir", "/tmp/x", "--serve_after", "1",
+         "--serve_port", "9777"]
+    ))
+
+
+# ----------------------------------------------------------- the storm
+
+
+def test_serve_storm_over_live_cluster(tmp_path):
+    """16 concurrent clients against the full stack — frontend +
+    micro-batcher + remote 2-shard graph: every client completes with
+    retries, shedding shows on the live scrape, p99 stays bounded, and
+    served rows stay bit-identical to the direct path."""
+    import jax
+
+    import euler_tpu
+    from euler_tpu.graph.service import GraphService
+    from euler_tpu.serve import EmbedServer
+    from euler_tpu.serving import EmbedClient, EmbedFrontend
+    from euler_tpu.train import get_optimizer
+    from tests.fixture_graph import write_fixture
+
+    data = str(tmp_path / "data")
+    reg = str(tmp_path / "reg")
+    import os
+
+    os.makedirs(data)
+    os.makedirs(reg)
+    write_fixture(data, num_partitions=4)
+    services = [GraphService(data, s, 2, registry=reg) for s in range(2)]
+    server = frontend = None
+    try:
+        remote = euler_tpu.Graph(mode="remote", registry=reg, retries=4)
+        model = _sage()
+        state = model.init_state(
+            jax.random.PRNGKey(3), remote, np.arange(8),
+            get_optimizer("adam", 0.01),
+        )
+        server = EmbedServer(
+            model, remote, state, max_batch=8, max_wait_us=1000,
+            queue_cap=2, slo_ms=5000.0,
+        ).start()
+        frontend = EmbedFrontend(server, port=0, max_conns=24)
+        server.embed_direct(1)  # compile outside the measured window
+
+        ids = sorted(TOPOLOGY)
+        per_client = 8
+        completed: dict = {}
+
+        def client(cid):
+            import random
+
+            rng = random.Random(cid)
+            c = EmbedClient(frontend.address)
+            done = retries = 0
+            try:
+                while done < per_client:
+                    pick = rng.sample(ids, rng.randint(1, 3))
+                    try:
+                        rows = c.embed(pick)
+                    except BusyError:
+                        retries += 1
+                        time.sleep(0.002)
+                        continue
+                    assert rows.shape == (len(pick), 8)
+                    done += 1
+                completed[cid] = retries
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+
+        scrape = EmbedClient(frontend.address)
+        stats = scrape.stats()
+        assert len(completed) == 16, "a client thread died"
+        assert stats["slo"]["count"] >= 16 * per_client
+        assert stats["slo"]["p99_ms"] <= 5000.0
+        assert stats["counters"]["serve_busy_rejects"] > 0, (
+            "queue_cap=2 under 16 clients must shed"
+        )
+        assert (stats["counters"]["serve_batches"]
+                < stats["counters"]["serve_requests"])
+        # parity survived the storm
+        row = scrape.embed([ids[3]])[0]
+        np.testing.assert_array_equal(row, server.embed_direct(ids[3]))
+        scrape.close()
+    finally:
+        if frontend is not None:
+            frontend.drain(grace_s=2.0)
+        if server is not None:
+            server.close()
+        if frontend is not None:
+            frontend.stop()
+        for s in services:
+            s.drain()
+            s.stop()
+
+
+# ------------------------------------------------- end-to-end CLI glue
+
+_CLI_COMMON = [
+    "--max_id", "16", "--feature_idx", "0", "--feature_dim", "2",
+    "--label_idx", "2", "--label_dim", "3", "--train_edge_type", "0,1",
+    "--all_edge_type", "0,1", "--fanouts", "3,2", "--dim", "8",
+    "--batch_size", "8", "--num_epochs", "2", "--log_steps", "100",
+    "--model", "graphsage_supervised",
+]
+
+
+def test_serve_cli_glue_restores_and_serves(fixture_dir, tmp_path):
+    """The `python -m euler_tpu.serve` wiring without the signal loop:
+    train a checkpoint via run_loop, then build the server from the
+    same flag surface (restore_serving_state + build_server +
+    run_serve(block=False)) and round-trip an embed."""
+    from euler_tpu import run_loop, serve
+    from euler_tpu.parallel import make_mesh
+    from euler_tpu.serving import EmbedClient
+
+    ck = str(tmp_path / "ck")
+    base = ["--data_dir", fixture_dir, "--model_dir", ck] + _CLI_COMMON
+    assert run_loop.main(base + ["--mode", "train"]) == 0
+
+    args = run_loop.define_flags().parse_args(
+        base + ["--serve_port", "0", "--serve_max_batch", "4",
+                "--serve_slo_ms", "500"]
+    )
+    args.mode = "evaluate"  # what serve.main() forces
+    graph, services = run_loop.build_graph(args)
+    server = frontend = None
+    try:
+        mesh = make_mesh(args.num_devices)
+        model = run_loop.build_model(args, graph)
+        server, frontend = serve.run_serve(
+            model, graph, args, mesh, block=False
+        )
+        c = EmbedClient(frontend.address)
+        rows = c.embed([10, 16])
+        assert rows.shape == (2, 8) and rows.dtype == np.float32
+        np.testing.assert_array_equal(rows[0], server.embed_direct(10))
+        c.close()
+    finally:
+        if frontend is not None:
+            frontend.drain(grace_s=1.0)
+        if server is not None:
+            server.close()
+        if frontend is not None:
+            frontend.stop()
+        for s in services:
+            if hasattr(s, "drain"):
+                s.drain()
+            s.stop()
+
+    # serving an untrained --model_dir fails LOUDLY at startup
+    args2 = run_loop.define_flags().parse_args(
+        ["--data_dir", fixture_dir,
+         "--model_dir", str(tmp_path / "never")] + _CLI_COMMON
+    )
+    args2.mode = "evaluate"
+    graph2, services2 = run_loop.build_graph(args2)
+    try:
+        model2 = run_loop.build_model(args2, graph2)
+        with pytest.raises(ValueError, match="no checkpoint in"):
+            serve.build_server(model2, graph2, args2,
+                               make_mesh(args2.num_devices))
+    finally:
+        for s in services2:
+            s.stop()
+
+
+def test_serve_after_trains_then_serves_until_sigterm(fixture_dir,
+                                                      tmp_path):
+    """run_loop --serve_after=1 end-to-end in a subprocess: train, save,
+    serve on the flagged port, answer a live embed, drain on SIGTERM,
+    exit 0."""
+    import os
+    import re
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    from euler_tpu.serving import EmbedClient
+
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        port = s.getsockname()[1]  # free-port probe (tiny reuse race)
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "euler_tpu",
+         "--data_dir", fixture_dir, "--model_dir", ck, "--mode", "train",
+         "--serve_after", "1", "--serve_port", str(port),
+         "--serve_max_batch", "4"] + _CLI_COMMON,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+    try:
+        deadline = time.monotonic() + 180
+        client = None
+        while time.monotonic() < deadline:
+            assert proc.poll() is None, (
+                f"run_loop died early:\n{proc.stdout.read()}"
+            )
+            try:
+                client = EmbedClient(f"127.0.0.1:{port}", timeout_s=5)
+                break
+            except OSError:
+                time.sleep(0.25)
+        assert client is not None, "server never came up"
+        rows = client.embed([12, 15, 12])
+        assert rows.shape == (3, 8)
+        np.testing.assert_array_equal(rows[0], rows[2])
+        assert client.stats()["slo"]["count"] >= 1
+        client.close()
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == 0, f"non-zero exit:\n{out}"
+        assert re.search(r"serve SLO at exit", out)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
