@@ -57,6 +57,14 @@ from euler_tpu.heat import (
     heat_topk,
     set_heat,
 )
+from euler_tpu.devprof import (
+    RecompileError,
+    compile_summary,
+    recompile_ledger,
+    sample_device_mem,
+    set_devprof,
+    watch,
+)
 from euler_tpu.serving import (
     BusyError,
     DeadlineError,
@@ -73,5 +81,7 @@ __all__ = [
     "scrape", "set_telemetry", "slow_spans", "telemetry_json",
     "telemetry_reset", "blackbox_json", "postmortem_read",
     "set_blackbox", "heat_json", "heat_topk", "heat_reset", "set_heat",
+    "RecompileError", "compile_summary", "recompile_ledger",
+    "sample_device_mem", "set_devprof", "watch",
     "EmbedServer", "EmbedClient", "BusyError", "DeadlineError",
 ]

@@ -1,0 +1,465 @@
+"""Device-plane observability: compile attribution, HBM gauges, transfer
+counters (OBSERVABILITY.md "Device plane").
+
+Four observability planes (PR 5-8) instrumented the host and the wire;
+this module watches the DEVICE half of the step: every XLA backend
+compile (count + log2-µs latency histogram), every recompile after a
+function's warmup (the classic silent 100x — a shape/dtype drift makes
+jit quietly rebuild the program), device memory in use, and the
+host<->device transfer volume. Everything lands in the existing native
+surfaces through the eg_counter_add / eg_phase_record / eg_devprof ABI,
+so metrics_text(), the STATS scrape, blackbox postmortems and
+scripts/metrics_dump.py report the device plane with zero new plumbing:
+
+    devprof.install()                once per process, before first jit
+    fn = devprof.watch(jitted, "loss_step")   recompile attribution
+    devprof.recompile_ledger()       journaled recompiles, newest last
+    devprof.sample_device_mem()      one-shot HBM/buffer gauge refresh
+    devprof.count_h2d(batch)         transfer-byte bracketing
+    devprof.set_devprof(False)       process-global kill-switch
+
+Compile COUNTS ride ``device_compiles`` / ``device_recompiles`` /
+``serve_recompiles`` (eg_stats.h), compile LATENCY rides the
+``phase:compile`` histogram (eg_phase.h), memory gauges ride the
+blackbox resource section (eg_blackbox.h + eg_devprof.h). The primary
+compile detector is a ``jax.monitoring`` event listener (exact backend
+compile durations); where events are unavailable the wrapped-jit
+fallback in :class:`Watched` feeds the same counters from cache-size
+deltas. Attribution (WHICH function recompiled, WHAT drifted) always
+comes from :class:`Watched`'s per-function shape-signature registry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from euler_tpu import telemetry
+from euler_tpu.graph import native
+from euler_tpu.graph.native import lib
+
+log = logging.getLogger("euler_tpu.devprof")
+
+# The jax.monitoring event key of one XLA backend compile (fires once
+# per compile, duration in seconds). Pinned by tests against the live
+# jax in the image; a jax without it simply leaves the listener idle
+# and the wrapped-jit fallback owns the counters.
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_LEDGER_CAP = 256
+
+_enabled = True
+_installed = False
+_listener_ok = False
+_lock = threading.Lock()
+_ledger: list = []
+_sampler_stop = None
+_sampler_thread = None
+
+
+class RecompileError(RuntimeError):
+    """A watched function recompiled after warmup under strict=True
+    (the eg_serve ``strict_bucket=`` contract: the padded fixed-bucket
+    forward must compile exactly once)."""
+
+
+def devprof_enabled() -> bool:
+    return _enabled
+
+
+def set_devprof(on: bool) -> None:
+    """Process-global device-plane kill-switch (`devprof=` config key):
+    False stops compile counting/journaling, memory sampling and
+    transfer-byte counting — the listener and wrappers stay in place
+    but write nothing."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def monitoring_active() -> bool:
+    """True when the jax.monitoring compile listener is registered (it
+    then owns device_compiles + the compile histogram; the wrapped-jit
+    fallback only attributes)."""
+    return _listener_ok
+
+
+def _on_event_duration(event: str, duration: float, **kw) -> None:
+    # Called from inside jax's compile path — must never raise.
+    try:
+        if not _enabled or event != COMPILE_EVENT:
+            return
+        native.counter_add("device_compiles")
+        telemetry.record_phase("compile", duration * 1e6)
+    except Exception:  # pragma: no cover - defensive
+        pass
+
+
+def install(sample_ms: int = 0) -> bool:
+    """Arm the device plane (idempotent): register the jax.monitoring
+    compile listener; with ``sample_ms > 0`` also start the background
+    device-memory sampler. Returns True when the listener registered
+    (False = fallback mode: Watched owns the counters too)."""
+    global _installed, _listener_ok
+    with _lock:
+        if not _installed:
+            try:
+                import jax.monitoring as _mon
+
+                _mon.register_event_duration_secs_listener(
+                    _on_event_duration
+                )
+                _listener_ok = True
+            except Exception as e:  # noqa: BLE001 - fallback mode
+                log.info("devprof: jax.monitoring unavailable (%s); "
+                         "wrapped-jit fallback owns compile counters", e)
+                _listener_ok = False
+            _installed = True
+    if sample_ms > 0:
+        start_sampler(sample_ms)
+    return _listener_ok
+
+
+def setup(enabled: bool = True, compile_cache: bool | None = None,
+          model_dir: str | None = None, sample_ms: int = 0) -> bool:
+    """CLI-startup arming shared by `python -m euler_tpu.run_loop` and
+    `python -m euler_tpu.serve` (their --devprof / --compile_cache
+    flags land here). Disarms the plane when ``enabled`` is False;
+    otherwise installs the compile listener, optionally starts the
+    memory sampler, and points JAX's persistent compilation cache at
+    $JAX_COMPILATION_CACHE_DIR / <model_dir>/jax_cache —
+    ``compile_cache=None`` means auto: on for TPU/GPU backends (where a
+    program compile costs 20-40 s), off on CPU. Returns devprof_enabled().
+    """
+    if not enabled:
+        set_devprof(False)
+        return False
+    install(sample_ms=sample_ms)
+    on = compile_cache
+    if on is None:
+        import jax
+
+        on = jax.default_backend() != "cpu"
+    if on:
+        import os
+
+        from euler_tpu.parallel import enable_compile_cache
+
+        d = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+            model_dir or ".", "jax_cache"
+        )
+        enable_compile_cache(default_dir=d)
+        log.info("devprof: persistent compile cache at %s", d)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# compile attribution: per-function shape-signature registry
+# ---------------------------------------------------------------------------
+
+
+def _leaf_sig(x) -> tuple:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    return (type(x).__name__,)
+
+
+def _signature(args: tuple, kwargs: dict) -> tuple:
+    import jax
+
+    return tuple(
+        _leaf_sig(leaf)
+        for leaf in jax.tree_util.tree_leaves((args, kwargs))
+    )
+
+
+def sig_diff(old, new) -> list:
+    """Human-readable per-leaf diff between two signatures — the
+    'exactly WHAT drifted' half of a recompile journal entry."""
+    if old is None:
+        return ["first compile"]
+    out = []
+    n = max(len(old), len(new))
+    for i in range(n):
+        a = old[i] if i < len(old) else None
+        b = new[i] if i < len(new) else None
+        if a != b:
+            out.append(f"leaf{i}: {_fmt_sig(a)} -> {_fmt_sig(b)}")
+    return out or [f"leaf count {len(old)} -> {len(new)}"]
+
+
+def _fmt_sig(s) -> str:
+    if s is None:
+        return "absent"
+    if len(s) == 2:
+        return f"{s[0]} {s[1]}"
+    return str(s[0])
+
+
+def _journal(entry: dict) -> None:
+    with _lock:
+        _ledger.append(entry)
+        del _ledger[:-_LEDGER_CAP]
+    # the same event lands in the slow-span journal (op 0 = "other",
+    # client side) so a scrape's slowest-N view shows the recompile
+    # wall time next to the RPC spans it starved
+    telemetry.record_span(int(entry["wall_us"]), op=0, side="client")
+    log.warning("devprof: recompile of %s after warmup: %s",
+                entry["fn"], "; ".join(entry["diff"]))
+
+
+def recompile_ledger() -> list:
+    """Journaled post-warmup recompiles, oldest first (bounded to the
+    last 256): [{"t_us", "fn", "diff", "sig", "prev", "wall_us"}]."""
+    with _lock:
+        return list(_ledger)
+
+
+def devprof_reset() -> None:
+    """Clear the recompile ledger (native gauges/counters reset with
+    telemetry_reset()/counters_reset())."""
+    with _lock:
+        del _ledger[:]
+
+
+class Watched:
+    """A jitted callable with a shape-signature registry: detects every
+    compile the call triggered (cache-size delta; signature-registry
+    fallback), and journals any compile AFTER warmup as a recompile
+    with the exact arg-shape/dtype diff that caused it.
+
+    ``on_recompile(entry)`` is the serve compile-storm hook;
+    ``strict=True`` raises :class:`RecompileError` (the result is
+    computed first — the caller may catch and keep it)."""
+
+    def __init__(self, fn, name: str | None = None, strict: bool = False,
+                 counter: str = "device_recompiles",
+                 on_recompile=None):
+        self._fn = fn
+        self.name = name or getattr(fn, "__name__", "jit_fn")
+        self.strict = strict
+        self._counter = counter
+        self._on_recompile = on_recompile
+        self._sigs: dict = {}
+        self._last_sig = None
+        self.warm = False
+        self.compiles = 0
+        self.recompiles = 0
+
+    def _cache_size(self):
+        cs = getattr(self._fn, "_cache_size", None)
+        if cs is None:
+            return None
+        try:
+            return cs()
+        except Exception:  # noqa: BLE001 - jit internals moved
+            return None
+
+    def mark_warm(self) -> None:
+        """Declare warmup done: the NEXT compile is a recompile even if
+        no tracked call compiled yet (serve warms up out-of-band)."""
+        self.warm = True
+
+    def __call__(self, *args, **kwargs):
+        if not _enabled:
+            return self._fn(*args, **kwargs)
+        before = self._cache_size()
+        t0 = time.monotonic()
+        out = self._fn(*args, **kwargs)
+        wall_us = int((time.monotonic() - t0) * 1e6)
+        after = self._cache_size()
+        if after is not None and before is not None:
+            if after == before:
+                # steady state — in-bucket dispatch, nothing compiled,
+                # so the arg signature (the expensive half of
+                # attribution) is never built; _last_sig stays at the
+                # sig that triggered the last compile, which is exactly
+                # the "previous" side a future recompile diffs against
+                return out
+            compiled = True
+            sig = _signature(args, kwargs)
+        else:
+            # no _cache_size on this callable: signature-registry
+            # fallback has to price the signature on every call
+            sig = _signature(args, kwargs)
+            compiled = sig not in self._sigs
+        self._sigs.setdefault(sig, 0)
+        self._sigs[sig] += 1
+        if not compiled:
+            self._last_sig = sig
+            return out
+        self.compiles += 1
+        if not _listener_ok:
+            # fallback mode: the wrapper owns the count + latency too
+            # (call wall time — compile dominates a compiling call)
+            native.counter_add("device_compiles")
+            telemetry.record_phase("compile", wall_us)
+        if self.warm:
+            self.recompiles += 1
+            entry = {
+                "t_us": int(time.monotonic() * 1e6),
+                "fn": self.name,
+                "diff": sig_diff(self._last_sig, sig),
+                "sig": sig,
+                "prev": self._last_sig,
+                "wall_us": wall_us,
+            }
+            native.counter_add(self._counter)
+            _journal(entry)
+            self._last_sig = sig
+            if self._on_recompile is not None:
+                self._on_recompile(entry)
+            if self.strict:
+                raise RecompileError(
+                    f"{self.name} recompiled after warmup: "
+                    f"{'; '.join(entry['diff'])}"
+                )
+            return out
+        self.warm = True
+        self._last_sig = sig
+        return out
+
+
+def watch(fn, name: str | None = None, strict: bool = False,
+          counter: str = "device_recompiles", on_recompile=None) -> Watched:
+    """Wrap a jitted callable with recompile attribution (see
+    :class:`Watched`). The wrapper is transparent (same args/returns)
+    and free when the kill-switch is off."""
+    return Watched(fn, name=name, strict=strict, counter=counter,
+                   on_recompile=on_recompile)
+
+
+# ---------------------------------------------------------------------------
+# device memory & transfer telemetry
+# ---------------------------------------------------------------------------
+
+
+def sample_device_mem() -> tuple:
+    """One device-memory sample pushed into the native gauges (and from
+    there into blackbox resource rings, postmortems and metrics_text):
+    (bytes_in_use, live_buffers). Uses device.memory_stats() where the
+    backend reports it (TPU/GPU); falls back to a jax.live_arrays()
+    census (CPU — the census IS the live-buffer truth there)."""
+    if not _enabled:
+        return (0, 0)
+    import jax
+
+    arrs = jax.live_arrays()
+    buffers = len(arrs)
+    bytes_in_use = None
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            bytes_in_use = int(stats.get("bytes_in_use", 0)) or None
+    except Exception:  # noqa: BLE001 - backend without memory_stats
+        bytes_in_use = None
+    if bytes_in_use is None:
+        bytes_in_use = int(sum(getattr(a, "nbytes", 0) for a in arrs))
+    lib().eg_devprof_set_mem(bytes_in_use, buffers)
+    return (bytes_in_use, buffers)
+
+
+def start_sampler(period_ms: int = 1000) -> None:
+    """Background device-memory sampler (daemon; idempotent): refreshes
+    the native gauges every ``period_ms`` so the blackbox resource ring
+    (eg_blackbox.h SamplerLoop reads the gauges on ITS cadence) and any
+    scrape see a live trajectory, not just the last manual sample."""
+    global _sampler_stop, _sampler_thread
+    with _lock:
+        if _sampler_thread is not None and _sampler_thread.is_alive():
+            return
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(max(period_ms, 50) / 1000.0):
+                try:
+                    sample_device_mem()
+                except Exception:  # pragma: no cover - keep sampling
+                    pass
+
+        t = threading.Thread(target=loop, name="eg-devprof-sampler",
+                             daemon=True)
+        t.start()
+        _sampler_stop, _sampler_thread = stop, t
+
+
+def stop_sampler() -> None:
+    global _sampler_stop, _sampler_thread
+    with _lock:
+        if _sampler_stop is not None:
+            _sampler_stop.set()
+        _sampler_stop = _sampler_thread = None
+
+
+def tree_bytes(tree) -> int:
+    """Total array bytes across a pytree's leaves."""
+    import jax
+
+    # size * itemsize rather than .nbytes: jax.Array's nbytes property
+    # re-derives the byte count through the sharding machinery (~2.5 us
+    # per leaf) and this census rides every step's h2d hook
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is not None and dtype is not None:
+            total += int(size) * dtype.itemsize
+        else:
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
+def count_h2d(tree) -> int:
+    """Bump ``h2d_bytes`` by the byte size of a pytree about to cross
+    host->device (train shard_batch / serve dispatch call sites).
+    Returns the bytes counted (0 when the kill-switch is off)."""
+    if not _enabled:
+        return 0
+    n = tree_bytes(tree)
+    if n:
+        native.counter_add("h2d_bytes", n)
+    return n
+
+
+def count_d2h(tree) -> int:
+    """Bump ``d2h_bytes`` for a device->host materialization (fetched
+    losses/metrics, served embedding rows)."""
+    if not _enabled:
+        return 0
+    n = tree_bytes(tree)
+    if n:
+        native.counter_add("d2h_bytes", n)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# summaries (run_loop first-step line, scripts/devprof_dump.py)
+# ---------------------------------------------------------------------------
+
+
+def compile_summary(data: dict | None = None) -> dict:
+    """One-line compile economics from a telemetry dump (default: this
+    process): counts, total/percentile compile wall, memory high-water.
+    The run_loop logs this after the first step so a relaunch with a
+    warm compilation cache is visibly cheap."""
+    data = data or telemetry.telemetry_json()
+    h = data["hist"].get("phase:compile") or {"b": [0], "count": 0,
+                                              "sum_us": 0}
+    pct = telemetry.percentiles(h, (50, 99)) if h["count"] else {}
+    res = data.get("resource", {})
+    return {
+        "compiles": data["counters"].get("device_compiles", 0),
+        "recompiles": data["counters"].get("device_recompiles", 0),
+        "serve_recompiles": data["counters"].get("serve_recompiles", 0),
+        "compile_events": h["count"],
+        "compile_ms_total": round(h["sum_us"] / 1000.0, 1),
+        "compile_ms_p50": round(pct.get(50, 0.0) / 1000.0, 1),
+        "compile_ms_p99": round(pct.get(99, 0.0) / 1000.0, 1),
+        "h2d_bytes": data["counters"].get("h2d_bytes", 0),
+        "d2h_bytes": data["counters"].get("d2h_bytes", 0),
+        "device_mem_bytes": res.get("device_mem_bytes", 0),
+        "device_mem_peak_bytes": res.get("device_mem_peak_bytes", 0),
+        "device_buffers": res.get("device_buffers", 0),
+    }

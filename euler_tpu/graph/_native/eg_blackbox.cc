@@ -16,6 +16,7 @@
 #include <thread>
 
 #include "eg_cache.h"
+#include "eg_devprof.h"
 #include "eg_stats.h"
 
 namespace eg {
@@ -235,6 +236,8 @@ ResourceSample Blackbox::SampleResources() {
   s.cache_bytes = GlobalCacheBytes().load(std::memory_order_relaxed);
   s.nbr_cache_bytes =
       GlobalNbrCacheBytes().load(std::memory_order_relaxed);
+  s.device_mem_bytes = Devprof::Global().mem_bytes();
+  s.device_buffers = Devprof::Global().buffers();
   return s;
 }
 
@@ -395,6 +398,9 @@ void Blackbox::DumpToFd(int fd, int sig) {
     w.Ch(',');
     w.Key("cache_bytes");
     w.I64(s.cache_bytes);
+    w.Ch(',');
+    w.Key("device_mem_bytes");
+    w.I64(s.device_mem_bytes);
     w.Ch('}');
   }
   w.Ch(']');
@@ -580,6 +586,15 @@ void Blackbox::ResourceJsonBody(std::string* out) {
   AppendKey(out, "nbr_cache_bytes");
   AppendI64(out, s.nbr_cache_bytes);
   out->push_back(',');
+  AppendKey(out, "device_mem_bytes");
+  AppendI64(out, s.device_mem_bytes);
+  out->push_back(',');
+  AppendKey(out, "device_mem_peak_bytes");
+  AppendI64(out, Devprof::Global().mem_peak_bytes());
+  out->push_back(',');
+  AppendKey(out, "device_buffers");
+  AppendI64(out, s.device_buffers);
+  out->push_back(',');
   AppendKey(out, "history_depth");
   uint64_t hh = hist_head_.load(std::memory_order_acquire);
   AppendU64(out, hh > kBbHistorySlots ? kBbHistorySlots : hh);
@@ -624,6 +639,9 @@ std::string Blackbox::HistoryJson(int shard) {
     o.push_back(',');
     AppendKey(&o, "cache_bytes");
     AppendI64(&o, s.cache_bytes);
+    o.push_back(',');
+    AppendKey(&o, "device_mem_bytes");
+    AppendI64(&o, s.device_mem_bytes);
     o.push_back('}');
   }
   o.append("]}");

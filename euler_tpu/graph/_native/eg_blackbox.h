@@ -106,6 +106,9 @@ struct ResourceSample {
   int64_t threads = 0;      // /proc/self/status Threads:
   int64_t cache_bytes = 0;  // client feature-cache bytes (eg_cache.h)
   int64_t nbr_cache_bytes = 0;  // client neighbor-list cache bytes
+  int64_t device_mem_bytes = 0;  // device bytes in use (eg_devprof.h —
+                                 // memory_stats() or live-array census)
+  int64_t device_buffers = 0;    // live device buffer count
 };
 
 // A history-ring slot: individually-atomic fields, same reasoning as
@@ -118,6 +121,7 @@ struct ResourceCell {
   std::atomic<int64_t> open_fds{0};
   std::atomic<int64_t> threads{0};
   std::atomic<int64_t> cache_bytes{0};
+  std::atomic<int64_t> device_mem_bytes{0};
 
   void Store(const ResourceSample& s) {
     t_us.store(s.t_us, std::memory_order_relaxed);
@@ -125,6 +129,7 @@ struct ResourceCell {
     open_fds.store(s.open_fds, std::memory_order_relaxed);
     threads.store(s.threads, std::memory_order_relaxed);
     cache_bytes.store(s.cache_bytes, std::memory_order_relaxed);
+    device_mem_bytes.store(s.device_mem_bytes, std::memory_order_relaxed);
   }
   ResourceSample Load() const {
     ResourceSample s;
@@ -133,6 +138,7 @@ struct ResourceCell {
     s.open_fds = open_fds.load(std::memory_order_relaxed);
     s.threads = threads.load(std::memory_order_relaxed);
     s.cache_bytes = cache_bytes.load(std::memory_order_relaxed);
+    s.device_mem_bytes = device_mem_bytes.load(std::memory_order_relaxed);
     return s;
   }
 };

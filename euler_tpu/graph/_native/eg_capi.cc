@@ -13,6 +13,7 @@
 #include <vector>
 
 #include "eg_blackbox.h"
+#include "eg_devprof.h"
 #include "eg_engine.h"
 #include "eg_fault.h"
 #include "eg_heat.h"
@@ -694,6 +695,7 @@ void eg_telemetry_reset() {
     eg::Telemetry::Global().Reset();
     eg::PhaseStats::Global().Reset();
     eg::Heat::Global().Reset();
+    eg::Devprof::Global().Reset();
   }
   EG_API_GUARD()
 }
@@ -741,6 +743,30 @@ void eg_serve_record(int phase, uint64_t us) {
 void eg_serve_batch(uint64_t ids) {
   try {
     eg::PhaseStats::Global().RecordServeBatch(ids);
+  }
+  EG_API_GUARD()
+}
+
+// ---- device-plane gauges (eg_devprof.h; OBSERVABILITY.md "Device
+// plane") ----
+// Refresh the device-memory gauges: euler_tpu/devprof.py samples
+// device.memory_stats() (or a live-array census on CPU) and pushes the
+// result here so blackbox resource rings, postmortems and every metrics
+// surface see device bytes with zero new plumbing.
+void eg_devprof_set_mem(int64_t bytes, int64_t buffers) {
+  try {
+    eg::Devprof::Global().SetMem(bytes, buffers);
+  }
+  EG_API_GUARD()
+}
+
+// Refresh the live serve-SLO gauges (µs): euler_tpu/serving/slo.py
+// pushes its windowed p50/p99 and lifetime violations every few
+// records, so a scrape reads serving latency without draining.
+void eg_serve_slo_set(uint64_t p50_us, uint64_t p99_us,
+                      uint64_t violations, uint64_t count) {
+  try {
+    eg::Devprof::Global().SetServeSlo(p50_us, p99_us, violations, count);
   }
   EG_API_GUARD()
 }

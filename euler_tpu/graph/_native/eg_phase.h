@@ -45,11 +45,14 @@ enum StepPhase : int {
   kPhaseDevice,          // device compute, fenced via block_until_ready
   kPhaseHost,            // optimizer/bookkeeping tail on the host
   kPhaseStep,            // whole-step wall (the sum check for the rest)
+  kPhaseCompile,         // XLA backend compile (jax.monitoring via
+                         // euler_tpu/devprof.py — NOT part of the
+                         // step-sum identity; compiles overlap steps)
   kPhaseCount,
 };
 
 const char* const kPhaseNames[kPhaseCount] = {
-    "input_stall", "sample", "h2d", "device", "host", "step",
+    "input_stall", "sample", "h2d", "device", "host", "step", "compile",
 };
 
 // Prefetch pipeline gauges recorded as value histograms.

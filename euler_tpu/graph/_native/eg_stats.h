@@ -170,6 +170,22 @@ enum CounterId : int {
   kCtrServeBusyReject,
   kCtrServeDeadlineReject,
   kCtrServeBatch,
+  // Device-plane ledger (euler_tpu/devprof.py bumps these through the
+  // eg_counter_add ABI): the XLA side of the step. device_compiles
+  // counts every backend compile observed (jax.monitoring listener, or
+  // the wrapped-jit fallback where events are unavailable);
+  // device_recompiles counts compiles AFTER a watched function's
+  // warmup — each one is journaled with the arg-shape/dtype diff that
+  // triggered it, because a silent recompile is the classic way a
+  // fixed-bucket device program quietly becomes 100x slower.
+  // serve_recompiles is the eg_serve compile-storm guard's twin (the
+  // padded fixed-bucket forward must compile exactly once); h2d/d2h
+  // count transfer bytes bracketing the train/serve device boundaries.
+  kCtrDeviceCompile,
+  kCtrDeviceRecompile,
+  kCtrServeRecompile,
+  kCtrH2dBytes,
+  kCtrD2hBytes,
   kCtrCount,
 };
 
@@ -186,6 +202,8 @@ const char* const kCounterNames[kCtrCount] = {
     "cache_admit_rejects", "placement_fallbacks",
     "serve_requests",     "serve_busy_rejects",
     "serve_deadline_rejects", "serve_batches",
+    "device_compiles",    "device_recompiles",
+    "serve_recompiles",   "h2d_bytes",        "d2h_bytes",
 };
 
 class Counters {

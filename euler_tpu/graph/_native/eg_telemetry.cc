@@ -3,6 +3,7 @@
 #include <algorithm>
 
 #include "eg_blackbox.h"
+#include "eg_devprof.h"
 #include "eg_heat.h"
 #include "eg_phase.h"
 #include "eg_stats.h"
@@ -238,6 +239,12 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
   // them up with zero new plumbing (and a postmortem's frozen values
   // can be compared against what the live surfaces showed)
   Blackbox::Global().ResourceJsonInto(&o);
+
+  // live serve-SLO gauges (eg_devprof.h): the windowed p50/p99 and
+  // lifetime violation count euler_tpu/serving/slo.py pushes through
+  // the ABI — always emitted (zeros included) so metrics_text renders
+  // the eg_serve_slo_* families unconditionally
+  Devprof::Global().ServeSloJsonInto(&o);
 
   // data-plane heat (eg_heat.h): hot-vertex top-K, sketch totals,
   // per-op ids ledger, fan-out attribution, cache-efficacy classes —

@@ -138,6 +138,7 @@ class Graph:
         heat: bool | None = None,
         heat_topk: int | None = None,
         blackbox: bool | None = None,
+        devprof: bool | None = None,
         postmortem_dir: str | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
@@ -158,7 +159,7 @@ class Graph:
             "fault_seed", "feature_cache_mb", "neighbor_cache_mb",
             "cache_policy", "placement", "strict", "coalesce",
             "chunk_ids", "dispatch_workers", "wire_version", "telemetry",
-            "slow_spans", "heat", "heat_topk", "blackbox",
+            "slow_spans", "heat", "heat_topk", "blackbox", "devprof",
             "postmortem_dir", "cache_dir", "stream", "init",
         }
         unknown = set(cfg) - known
@@ -256,6 +257,12 @@ class Graph:
         blackbox = pick("blackbox", blackbox, None)
         if isinstance(blackbox, str):
             blackbox = str2bool(blackbox)
+        # device-plane observability (eg_devprof.h; process-global like
+        # blackbox=, valid in BOTH modes — an embedded-engine trainer
+        # compiles and recompiles XLA programs exactly like a remote one)
+        devprof = pick("devprof", devprof, None)
+        if isinstance(devprof, str):
+            devprof = str2bool(devprof)
         postmortem_dir = pick("postmortem_dir", postmortem_dir, None)
         cache_dir = pick("cache_dir", cache_dir, None)
         stream = pick("stream", stream, False)
@@ -325,6 +332,12 @@ class Graph:
             from euler_tpu import blackbox as _blackbox
 
             _blackbox.set_blackbox(bool(blackbox))
+        if devprof is not None:
+            from euler_tpu import devprof as _devprof
+
+            _devprof.set_devprof(bool(devprof))
+            if devprof:
+                _devprof.install()
         if postmortem_dir is not None:
             from euler_tpu import blackbox as _blackbox
 

@@ -81,6 +81,9 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_phase_gauge, None, [c.c_int, c.c_uint64])
     _sig(L.eg_serve_record, None, [c.c_int, c.c_uint64])
     _sig(L.eg_serve_batch, None, [c.c_uint64])
+    _sig(L.eg_devprof_set_mem, None, [c.c_int64, c.c_int64])
+    _sig(L.eg_serve_slo_set, None,
+         [c.c_uint64, c.c_uint64, c.c_uint64, c.c_uint64])
     _sig(L.eg_telemetry_enabled, c.c_int, [])
     _sig(L.eg_telemetry_set_enabled, None, [c.c_int])
     _sig(L.eg_telemetry_reset, None, [])

@@ -239,6 +239,19 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
     p.add_argument("--profile_dir", default="")
+    p.add_argument("--devprof", type=_str2bool, default=True, help=(
+        "device-plane observability kill-switch (eg_devprof): XLA "
+        "compile/recompile counters + latency histogram, post-warmup "
+        "recompile journaling with the offending shape diff, device-"
+        "memory gauges in the blackbox resource ring, h2d/d2h byte "
+        "counters; 0 disarms all of it (OBSERVABILITY.md 'Device "
+        "plane')"))
+    p.add_argument("--compile_cache", type=_str2bool, default=None, help=(
+        "persistent XLA compilation cache "
+        "(jax_compilation_cache_dir) so relaunches skip the 20-40 s "
+        "TPU program compiles. Unset = auto: on for TPU/GPU backends, "
+        "off on CPU. Cache dir: $JAX_COMPILATION_CACHE_DIR, else "
+        "<model_dir>/jax_cache"))
     # serving (euler_tpu/serve.py; DEPLOY.md "Serving runbook")
     p.add_argument("--serve_after", type=_str2bool, default=False, help=(
         "train mode: after training saves its final checkpoint, "
@@ -763,7 +776,10 @@ def run_train(model, graph, args, mesh):
             os.makedirs(
                 os.path.dirname(args.trace_file) or ".", exist_ok=True
             )
-            trace = write_trace(args.trace_file, recorder, graph)
+            # --profile_dir device lanes merge in, time-aligned via the
+            # eg_align marker train() stamped into the capture
+            trace = write_trace(args.trace_file, recorder, graph,
+                                profile_dir=args.profile_dir or None)
             log.info(
                 "trace: %d events -> %s (open in ui.perfetto.dev)",
                 len(trace["traceEvents"]), args.trace_file,
@@ -891,6 +907,14 @@ def main(argv=None) -> int:
 
     if not args.blackbox:
         blackbox_mod.set_blackbox(False)
+    # device plane + compile cache: before any jit so the listener sees
+    # every compile and the cache covers the first program
+    from euler_tpu import devprof as devprof_mod
+
+    devprof_mod.setup(enabled=args.devprof,
+                      compile_cache=args.compile_cache,
+                      model_dir=args.model_dir,
+                      sample_ms=1000)
     if args.postmortem_dir:
         # arm BEFORE any graph/service exists, so even a crash during
         # load or discovery leaves a dump

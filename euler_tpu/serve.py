@@ -51,6 +51,7 @@ from typing import Optional
 
 import numpy as np
 
+from euler_tpu import devprof
 from euler_tpu import telemetry as T
 from euler_tpu.graph import native
 from euler_tpu.serving import MicroBatcher, SLOTracker, EmbedFrontend
@@ -82,7 +83,8 @@ class EmbedServer:
     def __init__(self, model, graph, state, *, max_batch: int = 64,
                  max_wait_us: int = 2000, queue_cap: int = 128,
                  slo_ms: float = 100.0, seed: int = 42,
-                 sample_cache: int = 65536):
+                 sample_cache: int = 65536,
+                 strict_bucket: bool = False):
         import jax
 
         if getattr(model, "device_sampling", False):
@@ -98,7 +100,17 @@ class EmbedServer:
         self.sample_cache = max(int(sample_cache), 1)
         self._state = state
         self._jax = jax
-        self._embed_fn = jax.jit(model.make_embed_step())
+        # Compile-storm guard (OBSERVABILITY.md "Device plane"): the
+        # fixed-bucket design means ONE compile, ever — any post-warmup
+        # recompile is a broken bucket contract (and a silent 100x), so
+        # it bumps serve_recompiles + journals the shape diff; with
+        # strict_bucket= it raises devprof.RecompileError.
+        self._embed_fn = devprof.watch(
+            jax.jit(model.make_embed_step()),
+            name="embed_step",
+            strict=strict_bucket,
+            on_recompile=lambda e: native.counter_add("serve_recompiles"),
+        )
         self._cache: OrderedDict = OrderedDict()
         self._cache_lock = threading.Lock()
         self.slo = SLOTracker(slo_ms)
@@ -176,6 +188,7 @@ class EmbedServer:
             "serve_phases": phases,
             "counters": ctr,
             "batch": batch,
+            "devprof": devprof.compile_summary(),
         }
 
     # ---- internals ----
@@ -212,9 +225,11 @@ class EmbedServer:
             lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
             *padded,
         )
+        devprof.count_h2d(batch)
         emb = self._jax.block_until_ready(
             self._embed_fn(self._state, batch)
         )
+        devprof.count_d2h(emb)
         return np.asarray(emb)[:n]
 
     def _embed_unique(self, uids: np.ndarray) -> np.ndarray:
@@ -271,6 +286,7 @@ def build_server(model, graph, args, mesh) -> EmbedServer:
         slo_ms=args.serve_slo_ms,
         seed=args.seed,
         sample_cache=args.serve_sample_cache,
+        strict_bucket=bool(args.serve_strict_bucket),
     )
 
 
@@ -348,6 +364,11 @@ def main(argv=None) -> int:
     probe_backend_or_die()
     if not args.telemetry:
         T.set_telemetry(False)
+    # device plane + compile cache before the embed jit: the serve
+    # forward is the program the cache saves a relaunch from
+    # recompiling, and the compile-storm guard needs the listener live
+    devprof.setup(enabled=args.devprof, compile_cache=args.compile_cache,
+                  model_dir=args.model_dir, sample_ms=1000)
     graph, services = run_loop.build_graph(args)
     try:
         mesh = make_mesh(args.num_devices,

@@ -40,6 +40,7 @@ SERVE_FLAG_DEFAULTS = {
     "serve_max_conns": 64,
     "serve_sample_cache": 65536,
     "serve_deadline_ms": 0,
+    "serve_strict_bucket": 0,
 }
 
 
@@ -81,6 +82,13 @@ def add_serve_flags(p):
         "default per-request deadline; a request not dispatched within "
         "it is answered DEADLINE (serve_deadline_rejects). 0 = none. "
         "Clients can override per request"))
+    p.add_argument("--serve_strict_bucket", type=int,
+                   default=d["serve_strict_bucket"], help=(
+        "compile-storm guard severity: any post-warmup XLA recompile "
+        "of the serve forward already bumps serve_recompiles and "
+        "journals the shape diff; 1 additionally makes it raise (the "
+        "fixed-bucket program is the bit-parity anchor — a recompile "
+        "means the bucket contract broke)"))
     return p
 
 

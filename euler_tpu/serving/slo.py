@@ -13,6 +13,14 @@ from __future__ import annotations
 import math
 import threading
 
+# Every N records the tracker pushes its window p50/p99 + lifetime
+# violations into the native serve-SLO gauges (eg_devprof.h), so
+# metrics_text()/the STATS scrape read live serving latency
+# (eg_serve_slo_ms{quantile=...}) without draining the server. The push
+# sorts the window (O(w log w)) — amortized to every 32nd request it is
+# noise next to a device dispatch.
+_PUSH_EVERY = 32
+
 
 class SLOTracker:
     """p50/p99 of served request latency vs a target, over a ring of
@@ -35,6 +43,22 @@ class SLOTracker:
             self._count += 1
             if ms > self.target_ms:
                 self._violations += 1
+            push_due = self._count == 1 or self._count % _PUSH_EVERY == 0
+        if push_due:
+            self.push_gauges()
+
+    def push_gauges(self) -> None:
+        """Refresh the native live gauges (eg_serve_slo_ms /
+        eg_serve_slo_violations_total) from the current window."""
+        from euler_tpu.graph.native import lib
+
+        p50 = self.percentile(50)
+        p99 = self.percentile(99)
+        with self._lock:
+            violations, count = self._violations, self._count
+        lib().eg_serve_slo_set(
+            int(p50 * 1000), int(p99 * 1000), violations, count
+        )
 
     def percentile(self, q: float) -> float:
         """Exact q-th percentile (nearest-rank) of the window; 0.0 when

@@ -39,7 +39,10 @@ NUM_BUCKETS = 28
 
 # Step-phase order — MUST match eg_phase.h StepPhase (the profiler
 # records by index through the eg_phase_record ABI, pinned by tests).
-PHASES = ("input_stall", "sample", "h2d", "device", "host", "step")
+# "compile" is the device-plane add-on (euler_tpu/devprof.py): XLA
+# backend compile wall time, NOT part of the step-sum identity.
+PHASES = ("input_stall", "sample", "h2d", "device", "host", "step",
+          "compile")
 
 # Serve-request phase order — MUST match eg_phase.h ServePhase (the
 # serving layer records by index through the eg_serve_record ABI,
@@ -272,7 +275,8 @@ _HIST_FAMILIES = {
                 "Retry backoff sleeps, microseconds", "op"),
     "phase": ("eg_step_phase_us",
               "Training step-phase wall time (input_stall/sample/h2d/"
-              "device/host/step), microseconds", "phase"),
+              "device/host/step, plus XLA compile), microseconds",
+              "phase"),
     "prefetch_depth": ("eg_prefetch_queue_depth",
                        "Ready batches in the prefetch queue at consumer "
                        "dequeue (value histogram)", "op"),
@@ -311,6 +315,14 @@ _RESOURCE_FAMILIES = {
                     "Client feature-row cache resident bytes"),
     "nbr_cache_bytes": ("eg_nbr_cache_bytes",
                         "Client neighbor-list cache resident bytes"),
+    "device_mem_bytes": ("eg_device_mem_bytes",
+                         "Device (HBM) bytes in use — memory_stats() "
+                         "where present, live-array census on CPU"),
+    "device_mem_peak_bytes": ("eg_device_mem_peak_bytes",
+                              "High-water mark of eg_device_mem_bytes "
+                              "since start/reset"),
+    "device_buffers": ("eg_device_buffers",
+                       "Live device buffers at the last devprof sample"),
 }
 
 
@@ -399,6 +411,37 @@ def _render(sources: list) -> str:
             lines.append(
                 f"{fam}{_fmt_labels(dict(base))} {resource[rkey]}"
             )
+
+    # live serve-SLO gauges (eg_devprof.h "serve_slo" section): the
+    # windowed p50/p99 the SLOTracker pushes through the ABI, plus the
+    # lifetime violation count — a scrape reads serving latency without
+    # draining the server. Headers always (the section is always
+    # emitted, zeros included).
+    lines.append("# HELP eg_serve_slo_ms Serve request latency over the "
+                 "SLO tracker window, milliseconds")
+    lines.append("# TYPE eg_serve_slo_ms gauge")
+    for data, base in sources:
+        slo = data.get("serve_slo")
+        if slo is None:
+            continue
+        for q in ("p50", "p99"):
+            labels = dict(base)
+            labels["quantile"] = q
+            lines.append(
+                f"eg_serve_slo_ms{_fmt_labels(labels)} "
+                f"{slo[q + '_us'] / 1000.0:.3f}"
+            )
+    lines.append("# HELP eg_serve_slo_violations_total Lifetime serve "
+                 "replies over the SLO target")
+    lines.append("# TYPE eg_serve_slo_violations_total counter")
+    for data, base in sources:
+        slo = data.get("serve_slo")
+        if slo is None:
+            continue
+        lines.append(
+            f"eg_serve_slo_violations_total{_fmt_labels(dict(base))} "
+            f"{slo['violations']}"
+        )
 
     # data-plane heat (eg_heat.h "heat" section): per-(side, op) id
     # feeds, cache-efficacy classes, and the top-K concentration

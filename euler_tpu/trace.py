@@ -30,7 +30,11 @@ merges into an existing trace file) standalone.
 
 from __future__ import annotations
 
+import glob
+import gzip
 import json
+import os
+import re
 import threading
 import time
 from collections import deque
@@ -40,6 +44,14 @@ from euler_tpu import telemetry as _telemetry
 # Synthetic pids: one "process" lane per source in the merged view.
 PID_TRAIN = 1
 PID_SHARD_BASE = 100  # shard s renders as pid 100+s
+PID_DEVICE_BASE = 200  # jax.profiler device lanes render from pid 200
+
+# Name of the alignment marker devprof stamps into a jax.profiler
+# capture. The profiler's timestamps sit on their own epoch (NOT
+# CLOCK_MONOTONIC — observed ~850 s apart on Linux); embedding the
+# monotonic µs in an annotation name lets ingest_profiler_dir solve
+# for the offset exactly instead of guessing from wall clocks.
+ALIGN_PREFIX = "eg_align:"
 
 
 def now_us() -> int:
@@ -171,6 +183,117 @@ def _flow_events(span_events: list) -> list:
     return out
 
 
+def align_annotation(monotonic_us: int | None = None):
+    """Context manager stamping the clock-alignment marker into an
+    active ``jax.profiler`` capture: a named TraceAnnotation whose name
+    carries CLOCK_MONOTONIC µs, so ingestion can map the profiler's
+    private epoch onto the exporter's timeline exactly. Enter it (with
+    an empty body) right after ``start_trace``."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(
+        f"{ALIGN_PREFIX}{monotonic_us if monotonic_us is not None else now_us()}"
+    )
+
+
+def _latest_profiler_trace(profile_dir: str) -> str | None:
+    """Newest ``*.trace.json(.gz)`` under the TensorBoard-style layout
+    ``<dir>/plugins/profile/<run>/`` that jax.profiler writes."""
+    root = os.path.join(profile_dir, "plugins", "profile")
+    paths = glob.glob(os.path.join(root, "*", "*.trace.json.gz"))
+    paths += glob.glob(os.path.join(root, "*", "*.trace.json"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+# Which profiler lanes are device-plane: TPU/GPU device processes, or
+# the XLA runtime executor threads (on CPU the kernel slices land on
+# threads named ``tf_XLATfrtCpuClient/...`` inside the python process).
+_DEVICE_PID_RE = re.compile(r"XLA|TPU|GPU|[Dd]evice")
+_DEVICE_TID_RE = re.compile(r"XLA")
+
+
+def ingest_profiler_dir(profile_dir: str, max_events: int = 50_000) -> list:
+    """A ``jax.profiler`` trace directory -> device-lane trace events
+    aligned to the exporter's CLOCK_MONOTONIC timeline.
+
+    Reads the newest capture, keeps the complete ("X") slices on
+    device/XLA-runtime lanes, shifts their timestamps by the offset
+    solved from the ``eg_align:<monotonic_us>`` annotation (raw
+    profiler time if no marker was stamped), and remaps pids to the
+    PID_DEVICE_BASE block so the kernels render as their own process
+    lanes next to the host phases. Returns [] when the directory holds
+    no capture — trace export must never fail a training teardown."""
+    path = _latest_profiler_trace(profile_dir)
+    if path is None:
+        return []
+    try:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as f:
+            raw = json.load(f)
+    except Exception:
+        return []
+    events = raw.get("traceEvents") or []
+
+    pid_names: dict = {}
+    tid_names: dict = {}
+    offset = None
+    for ev in events:
+        if ev.get("ph") == "M":
+            if ev.get("name") == "process_name":
+                pid_names[ev.get("pid")] = (ev.get("args") or {}).get(
+                    "name", ""
+                )
+            elif ev.get("name") == "thread_name":
+                tid_names[(ev.get("pid"), ev.get("tid"))] = (
+                    ev.get("args") or {}
+                ).get("name", "")
+        elif offset is None and "ts" in ev:
+            m = re.search(ALIGN_PREFIX + r"(\d+)", str(ev.get("name", "")))
+            if m:
+                offset = int(m.group(1)) - int(ev["ts"])
+    if offset is None:
+        offset = 0  # unstamped capture: lanes keep the profiler epoch
+
+    lanes: dict = {}  # source pid -> synthetic device pid
+    used_tids: set = set()
+    out = []
+    for ev in events:
+        if ev.get("ph") != "X" or "ts" not in ev:
+            continue
+        pid, tid = ev.get("pid"), ev.get("tid")
+        if not (
+            _DEVICE_PID_RE.search(pid_names.get(pid, ""))
+            or _DEVICE_TID_RE.search(tid_names.get((pid, tid), ""))
+        ):
+            continue
+        new_pid = lanes.setdefault(pid, PID_DEVICE_BASE + len(lanes))
+        used_tids.add((pid, tid))
+        out.append({
+            "name": ev.get("name", "?"), "cat": "device", "ph": "X",
+            "ts": int(ev["ts"]) + offset, "dur": int(ev.get("dur", 0)),
+            "pid": new_pid, "tid": tid,
+        })
+    if len(out) > max_events:
+        # Keep the biggest slices: a multi-step device capture can hold
+        # millions of sub-µs events that would swamp the merged export.
+        out.sort(key=lambda e: e["dur"], reverse=True)
+        del out[max_events:]
+        out.sort(key=lambda e: e["ts"])
+    for pid, new_pid in lanes.items():
+        out.append({
+            "name": "process_name", "ph": "M", "pid": new_pid,
+            "args": {"name": f"device: {pid_names.get(pid) or pid}"},
+        })
+    for pid, tid in used_tids:
+        name = tid_names.get((pid, tid))
+        if name:
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": lanes[pid],
+                "tid": tid, "args": {"name": name},
+            })
+    return out
+
+
 def chrome_trace(phase_events: list | None = None,
                  span_sources: list | None = None,
                  base_events: list | None = None) -> dict:
@@ -215,12 +338,18 @@ def gather_span_sources(graph=None) -> list:
 
 
 def write_trace(path: str, recorder: TraceRecorder | None = None,
-                graph=None, base_events: list | None = None) -> dict:
-    """Export the merged trace to ``path`` and return it."""
+                graph=None, base_events: list | None = None,
+                profile_dir: str | None = None) -> dict:
+    """Export the merged trace to ``path`` and return it. When a
+    ``jax.profiler`` capture directory is given its device lanes merge
+    in, time-aligned with the host phase events."""
+    base = list(base_events or [])
+    if profile_dir:
+        base.extend(ingest_profiler_dir(profile_dir))
     trace = chrome_trace(
         recorder.events() if recorder is not None else None,
         gather_span_sources(graph),
-        base_events,
+        base,
     )
     with open(path, "w") as f:
         json.dump(trace, f)

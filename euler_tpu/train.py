@@ -184,6 +184,8 @@ def train(
         donate_argnums=(0,),
     )
 
+    from euler_tpu import devprof
+
     if phase_profile is None:
         from euler_tpu.telemetry import telemetry_enabled
 
@@ -209,13 +211,17 @@ def train(
         t0 = time.perf_counter()
         batch = model.sample(graph, source_fn(step))
         if not phase_profile:
-            return shard_batch(batch, mesh) if device_prefetch else batch
+            if device_prefetch:
+                batch = shard_batch(batch, mesh)
+                devprof.count_h2d(batch)
+            return batch
         # prefetch applies the start offset before calling: step is
         # already the absolute step index here
         t1 = time.perf_counter()
         record_phase("sample", (t1 - t0) * 1e6, step=step)
         if device_prefetch:
             batch = shard_batch(batch, mesh)
+            devprof.count_h2d(batch)
             record_phase(
                 "h2d", (time.perf_counter() - t1) * 1e6, step=step
             )
@@ -237,6 +243,8 @@ def train(
         for m in window_metrics:
             acc = _metric_accumulate(name, acc, m)
         loss_v = float(last_loss)
+        # Metric/loss materialization is the training loop's d2h point.
+        devprof.count_d2h((window_metrics, last_loss))
         mv = _metric_value(name, acc)
         dt = time.time() - t0
         sps = len(window_metrics) / dt
@@ -279,16 +287,33 @@ def train(
         cur = steps_done  # 0-based step index, matches prefetch labels
         if profile_dir and steps_done - start_step == profile_steps[0]:
             jax.profiler.start_trace(profile_dir)
+            # Stamp the monotonic-clock marker so the device lanes of
+            # this capture can be time-aligned with the host phase
+            # events in the merged trace export (trace.py ingestion).
+            from euler_tpu.trace import align_annotation
+
+            with align_annotation():
+                pass
             profiling = True
         if not device_prefetch:
             t_h2d = time.perf_counter()
             batch = shard_batch(batch, mesh)
+            devprof.count_h2d(batch)
             if phase_profile:
                 record_phase(
                     "h2d", (time.perf_counter() - t_h2d) * 1e6, step=cur
                 )
         t_dev = time.perf_counter()
         state, last_loss, metric = step_fn(state, batch)
+        if cur == start_step and devprof.devprof_enabled():
+            # Relaunch-cost visibility: with the persistent compile
+            # cache warm this line drops to ~0 ms on the second launch.
+            cs = devprof.compile_summary()
+            (log_fn or log.info)(
+                f"first step dispatched: {cs['compile_events']} XLA "
+                f"compile(s), {cs['compile_ms_total']:.0f} ms compile "
+                "time"
+            )
         if phase_profile:
             jax.block_until_ready(last_loss)
             t_host = time.perf_counter()

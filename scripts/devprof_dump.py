@@ -1,0 +1,205 @@
+#!/usr/bin/env python3
+"""Device-plane report: compile economics, recompile ledger, memory
+high-water, and the top-K kernels of a jax.profiler capture.
+
+Three input modes, combinable (OBSERVABILITY.md "Device plane"):
+
+  * ``--profile_dir DIR`` — read a ``jax.profiler`` capture (the
+    training run's ``--profile_dir``) and print the top-K device
+    kernels by total self time, plus the lane inventory;
+  * ``--registry`` / ``--shards`` — scrape a live cluster and print
+    each shard's compile table (compiles, recompiles, compile wall,
+    transfer volume, device-memory high-water) and serve SLO gauges;
+  * ``--smoke`` — self-contained drill (verify.sh gate): jit a step,
+    inject a shape drift, assert exactly one journaled recompile with
+    the offending diff, capture a profiler trace around it, and
+    validate the merged host+device Perfetto export.
+
+Usage:
+    python scripts/devprof_dump.py --profile_dir /tmp/prof
+    python scripts/devprof_dump.py --registry /shared/reg
+    python scripts/devprof_dump.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def kernel_table(profile_dir: str, topk: int = 15) -> list:
+    """Top-K device kernels by total self time from a capture; returns
+    the aggregated (name, total_us, count) rows it printed."""
+    from euler_tpu.trace import ingest_profiler_dir
+
+    events = ingest_profiler_dir(profile_dir)
+    slices = [e for e in events if e.get("ph") == "X"]
+    if not slices:
+        print(f"no device slices found under {profile_dir}")
+        return []
+    lanes = {e["pid"] for e in slices}
+    agg: dict = defaultdict(lambda: [0, 0])
+    for e in slices:
+        agg[e["name"]][0] += e["dur"]
+        agg[e["name"]][1] += 1
+    rows = sorted(
+        ((name, tot, cnt) for name, (tot, cnt) in agg.items()),
+        key=lambda r: r[1], reverse=True,
+    )[:topk]
+    print(f"== device kernels ({len(slices)} slices, "
+          f"{len(lanes)} lane(s)) ==")
+    print(f"  {'kernel':40s} {'total_us':>10s} {'count':>7s} "
+          f"{'avg_us':>9s}")
+    for name, tot, cnt in rows:
+        print(f"  {name[:40]:40s} {tot:10d} {cnt:7d} {tot / cnt:9.1f}")
+    return rows
+
+
+def compile_table(sources: list) -> None:
+    """Per-source compile economics rows from telemetry dumps:
+    [(label, dump_dict), ...] (a scrape, or this process's)."""
+    from euler_tpu import devprof
+
+    print("== compile table ==")
+    print(f"  {'source':10s} {'compiles':>8s} {'recomp':>6s} "
+          f"{'serve_rc':>8s} {'compile_ms':>10s} {'p99_ms':>8s} "
+          f"{'h2d_MB':>8s} {'d2h_MB':>8s} {'mem_peak_MB':>11s}")
+    for label, data in sources:
+        s = devprof.compile_summary(data)
+        print(f"  {label:10s} {s['compiles']:8d} {s['recompiles']:6d} "
+              f"{s['serve_recompiles']:8d} {s['compile_ms_total']:10.1f} "
+              f"{s['compile_ms_p99']:8.1f} "
+              f"{s['h2d_bytes'] / 1e6:8.1f} {s['d2h_bytes'] / 1e6:8.1f} "
+              f"{s['device_mem_peak_bytes'] / 1e6:11.1f}")
+        slo = data.get("serve_slo")
+        if slo and slo.get("count"):
+            print(f"  {label:10s} serve SLO: p50 "
+                  f"{slo['p50_us'] / 1000.0:.1f}ms p99 "
+                  f"{slo['p99_us'] / 1000.0:.1f}ms "
+                  f"violations {slo['violations']}/{slo['count']}")
+
+
+def ledger_table() -> None:
+    """This process's journaled post-warmup recompiles."""
+    from euler_tpu import devprof
+
+    led = devprof.recompile_ledger()
+    if not led:
+        return
+    print(f"== recompile ledger ({len(led)}) ==")
+    for e in led:
+        print(f"  {e['fn']}: {'; '.join(e['diff'])} "
+              f"(wall {e['wall_us'] / 1000.0:.1f}ms)")
+
+
+def run_smoke() -> int:
+    """Self-contained device-plane drill (verify.sh gate)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu import devprof
+    from euler_tpu import telemetry as T
+    from euler_tpu import trace as trace_mod
+
+    T.telemetry_reset()
+    devprof.devprof_reset()
+    devprof.install()
+    step = devprof.watch(
+        jax.jit(lambda x: (x @ x.T).sum()), name="smoke_step"
+    )
+    x = jnp.ones((64, 32), jnp.float32)
+    step(x).block_until_ready()  # warmup: first compile, not a recompile
+
+    prof = tempfile.mkdtemp(prefix="euler_devprof_smoke_")
+    t0 = trace_mod.now_us()
+    jax.profiler.start_trace(prof)
+    with trace_mod.align_annotation():
+        pass
+    step(x).block_until_ready()  # in-bucket: no compile
+    # injected shape drift: the classic silent 100x, detected loudly
+    step(jnp.ones((48, 32), jnp.float32)).block_until_ready()
+    jax.profiler.stop_trace()
+    t1 = trace_mod.now_us()
+
+    devprof.sample_device_mem()
+    s = devprof.compile_summary()
+    assert s["recompiles"] == 1, s
+    assert s["compiles"] >= 2, s  # warmup + drift at minimum
+    led = devprof.recompile_ledger()
+    assert len(led) == 1 and led[0]["fn"] == "smoke_step", led
+    assert any("->" in d for d in led[0]["diff"]), led
+    assert s["device_mem_bytes"] > 0 and s["device_buffers"] > 0, s
+
+    # merged export: device lanes present, time-aligned, valid
+    events = trace_mod.ingest_profiler_dir(prof)
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs, "no device slices ingested"
+    assert all(e["pid"] >= trace_mod.PID_DEVICE_BASE for e in xs), xs[:3]
+    pad = 2_000_000  # capture bracketing slack, µs
+    aligned = [e for e in xs if t0 - pad <= e["ts"] <= t1 + pad]
+    assert len(aligned) == len(xs), (len(aligned), len(xs))
+    trace = trace_mod.chrome_trace(base_events=events)
+    trace_mod.validate_chrome_trace(trace)
+
+    compile_table([("local", T.telemetry_json())])
+    ledger_table()
+    kernel_table(prof, topk=5)
+    print("devprof_dump smoke: OK")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--profile_dir", default="", help=(
+        "jax.profiler capture directory (the run's --profile_dir) for "
+        "the top-K kernel table"))
+    ap.add_argument("--topk", type=int, default=15,
+                    help="kernel rows to print")
+    ap.add_argument("--registry", default="", help=(
+        "registry dir or tcp://host:port — scrape the live cluster's "
+        "compile tables"))
+    ap.add_argument("--shards", default="",
+                    help="explicit comma-separated host:port shard list")
+    ap.add_argument("--timeout_ms", type=int, default=3000)
+    ap.add_argument("--smoke", action="store_true", help=(
+        "self-contained recompile + merged-trace drill (verify.sh)"))
+    args = ap.parse_args()
+
+    if args.smoke:
+        return run_smoke()
+    if not args.profile_dir and not args.registry and not args.shards:
+        ap.error("need --profile_dir, --registry/--shards, or --smoke")
+
+    if args.registry or args.shards:
+        import euler_tpu
+        from euler_tpu import telemetry as T
+
+        g = euler_tpu.Graph(
+            mode="remote",
+            registry=args.registry or None,
+            shards=args.shards.split(",") if args.shards else None,
+            retries=2,
+            timeout_ms=args.timeout_ms,
+            rediscover_ms=0,
+        )
+        try:
+            compile_table([
+                (f"shard {s}", T.scrape(g, s))
+                for s in range(g.num_shards)
+            ])
+        finally:
+            g.close()
+    if args.profile_dir:
+        kernel_table(args.profile_dir, topk=args.topk)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

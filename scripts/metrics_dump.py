@@ -113,6 +113,26 @@ def dump_cluster(graph, as_json: bool = False) -> list:
         nonzero = {k: v for k, v in data["counters"].items() if v}
         if nonzero:
             print(f"  counters: {nonzero}")
+        # device plane (OBSERVABILITY.md "Device plane"): compile
+        # economics + memory high-water + transfer volume, whenever the
+        # scraped process has recorded any
+        res = data.get("resource", {})
+        c = data["counters"]
+        if (c.get("device_compiles") or c.get("device_recompiles")
+                or res.get("device_mem_peak_bytes")):
+            ch = data["hist"].get("phase:compile") or {"count": 0,
+                                                       "sum_us": 0}
+            print(
+                f"  device: {c.get('device_compiles', 0)} compiles "
+                f"({ch['sum_us'] / 1000.0:.0f} ms), "
+                f"{c.get('device_recompiles', 0)} recompiles, "
+                f"{c.get('serve_recompiles', 0)} serve recompiles, "
+                f"mem {res.get('device_mem_bytes', 0) / 1e6:.1f}MB "
+                f"(peak {res.get('device_mem_peak_bytes', 0) / 1e6:.1f}MB"
+                f", {res.get('device_buffers', 0)} buffers), "
+                f"h2d {c.get('h2d_bytes', 0) / 1e6:.1f}MB "
+                f"d2h {c.get('d2h_bytes', 0) / 1e6:.1f}MB"
+            )
         for sp in data["slow_spans"][:5]:
             print(f"  slow: {sp['op']:20s} {sp['total_us']:>9d}us "
                   f"queue={sp['queue_us']} handler={sp['handler_us']} "
@@ -175,6 +195,8 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
                 for k, v in ctr.items()
             }
             d_ctr = {k: v for k, v in d_ctr.items() if v}
+            # transfer volume reads as a bandwidth, not a raw delta
+            h2d, d2h = d_ctr.pop("h2d_bytes", 0), d_ctr.pop("d2h_bytes", 0)
             g = data.get("gauges", {})
             line = (f"[{stamp}] shard {s}: served +{d_served}"
                     f"{_rate(d_served, dt)} "
@@ -182,6 +204,13 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
                     f"queue {g.get('queue_depth', '?')} "
                     f"conns {g.get('conns', '?')} "
                     f"draining {g.get('draining', '?')}")
+            res = data.get("resource", {})
+            if res.get("device_mem_peak_bytes"):
+                line += (f" dev_mem {res.get('device_mem_bytes', 0) / 1e6:.0f}"
+                         f"/{res['device_mem_peak_bytes'] / 1e6:.0f}MB")
+            if not raw and (h2d or d2h) and dt > 0:
+                line += (f" h2d {h2d / dt / 1e6:.1f}MB/s "
+                         f"d2h {d2h / dt / 1e6:.1f}MB/s")
             if raw:
                 if ctr:
                     line += f"  counters {ctr}"

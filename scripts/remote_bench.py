@@ -285,6 +285,74 @@ def heat_ab_paired(reg: str, pairs: int, steps: int, batch: int, fanouts,
         g.close()
 
 
+def devprof_ab_paired(pairs: int, steps: int) -> dict:
+    """Paired interleaved devprof on/off measurement of the device-plane
+    hooks on the training hot path: a Watched jit step (recompile
+    attribution) plus the per-batch h2d/d2h byte census, exactly the
+    instrumentation train.py runs every step. The step is a fixed
+    4-layer matmul sized to a real train step (~0.5-1 ms on this CPU
+    image) — NOT the smoke graph's toy dims, where a ~10 us dispatch
+    would read any fixed per-step hook cost as a huge percentage. Same
+    pairing rationale as heat_ab_paired above — per pair both arms run
+    back-to-back with alternating order so box drift cancels, and the
+    median relative wall difference is the number the <2% overhead
+    contract is judged on (OBSERVABILITY.md "Device plane")."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu import devprof
+
+    devprof.install()
+
+    def _step(w, x):
+        h = x
+        for _ in range(4):
+            h = jnp.tanh(h @ w)
+        return h.sum()
+
+    step = devprof.watch(jax.jit(_step), name="devprof_ab_step")
+    w = jnp.ones((128, 128), jnp.float32)
+    x = jnp.ones((256, 128), jnp.float32)
+    jax.block_until_ready(step(w, x))  # warm: compile priced outside arms
+    diffs = []
+    try:
+        # settle pass (untimed): one full arm's worth of dispatches so
+        # allocator/dispatch caches reach steady state before pair 0 —
+        # a cold first arm otherwise lands entirely in its difference
+        for _ in range(steps):
+            out = step(w, x)
+        jax.block_until_ready(out)
+        for pair in range(pairs):
+            walls = {}
+            arms = [True, False] if pair % 2 == 0 else [False, True]
+            for flag in arms:
+                devprof.set_devprof(flag)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    devprof.count_h2d((w, x))
+                    out = step(w, x)
+                    devprof.count_d2h(out)
+                jax.block_until_ready(out)
+                walls[flag] = time.perf_counter() - t0
+            diffs.append(
+                (walls[True] - walls[False]) / walls[False] * 100.0
+            )
+    finally:
+        devprof.set_devprof(True)
+    diffs.sort()
+    return {
+        "pairs": pairs,
+        "steps_per_arm": steps,
+        "median_overhead_pct": round(statistics.median(diffs), 2),
+        "mean_overhead_pct": round(statistics.mean(diffs), 2),
+        "sem_pct": round(
+            statistics.stdev(diffs) / len(diffs) ** 0.5, 2
+        ) if len(diffs) > 1 else 0.0,
+    }
+
+
 def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
                      steps: int | None = None) -> dict:
     """Full before/after measurement; returns the bench-driver-shaped
@@ -363,6 +431,14 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
             reg, pairs=3 if smoke else 10, steps=max(2, steps // 2),
             batch=batch, fanouts=fanouts, feature_dim=feature_dim,
         )
+        # DEVPROF A/B: the device-plane hooks priced the same paired way,
+        # on the jit-dispatch hot path they actually ride (the remote
+        # sampling loop above never crosses a jit boundary, so a config
+        # A/B there would price nothing).
+        devprof_ab = devprof_ab_paired(
+            pairs=3 if smoke else 10,
+            steps=50 if smoke else 200,
+        )
         reduction = (
             after["ids_requested"] / after["ids_on_wire"]
             if after["ids_on_wire"] > 0 else float("inf")
@@ -392,6 +468,7 @@ def run_remote_bench(smoke: bool = False, inproc: bool | None = None,
                 "heat_off": heat_off,
                 "heat_overhead_pct": heat_overhead_pct,
                 "heat_ab": heat_ab,
+                "devprof_ab": devprof_ab,
                 "speedup": round(
                     after["edges_per_sec"] / before["edges_per_sec"], 3
                 ),

@@ -116,6 +116,17 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
 timeout -k 10 300 env JAX_PLATFORMS=cpu \
   python scripts/serve_drill.py --smoke >/dev/null || fail=1
 
+step "device plane: recompile attribution + merged-trace drill (OBSERVABILITY.md 'Device plane')"
+# eg_devprof: exact recompile arithmetic under injected shape drift,
+# kill-switch silence, the serve compile-storm guard on a live drill,
+# then the devprof_dump smoke — jit, drift, profiler capture, and a
+# validated host+device Perfetto merge — so a silent regression in the
+# compile ledger or the trace alignment fails verify first.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_devprof.py -q -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/devprof_dump.py --smoke >/dev/null || fail=1
+
 step "perf gate (scripts/perf_gate.py — strict for bench_smoke, warn-only remote)"
 # Smoke-to-smoke throughput trajectory check (PERF.md "Throughput
 # trajectory"). The host-only bench.py --smoke config now GATES verify

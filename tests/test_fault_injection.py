@@ -47,6 +47,10 @@ COUNTER_NAMES = {
     # caught before dispatch, and coalesced device dispatches
     "serve_requests", "serve_busy_rejects", "serve_deadline_rejects",
     "serve_batches",
+    # device-plane ledger (PR 15): XLA compiles/recompiles, the serve
+    # compile-storm guard, and host<->device transfer bytes
+    "device_compiles", "device_recompiles", "serve_recompiles",
+    "h2d_bytes", "d2h_bytes",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
