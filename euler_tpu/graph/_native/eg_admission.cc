@@ -177,7 +177,12 @@ bool AdmissionServer::Start(int listen_fd, const AdmissionOptions& opt,
   wake_w_ = pfds[1];
   ::fcntl(wake_r_, F_SETFL, O_NONBLOCK);
   ::fcntl(wake_w_, F_SETFL, O_NONBLOCK);
-  stop_ = false;
+  {
+    // poller thread not spawned yet, but take the lock anyway: the
+    // guarded-by contract is simpler than a start-ordering argument
+    std::lock_guard<PosixMutex> l(mu_);
+    stop_ = false;
+  }
   draining_.store(false, std::memory_order_release);
   poller_ = std::thread([this] {
     try {

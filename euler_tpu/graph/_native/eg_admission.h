@@ -161,10 +161,11 @@ class AdmissionServer {
   mutable PosixMutex mu_;  // guards ready_, returned_, all_fds_, stop_
   PosixCondVar ready_cv_;    // workers wait for ready conns
   PosixCondVar drained_cv_;  // Drain waits for conns_ == 0
-  std::deque<ReadyConn> ready_;
-  std::vector<int> returned_;
-  std::set<int> all_fds_;  // every open conn fd, for forced shutdown
-  bool stop_ = false;
+  std::deque<ReadyConn> ready_ EG_GUARDED_BY(mu_);
+  std::vector<int> returned_ EG_GUARDED_BY(mu_);
+  // every open conn fd, for forced shutdown
+  std::set<int> all_fds_ EG_GUARDED_BY(mu_);
+  bool stop_ EG_GUARDED_BY(mu_) = false;
   std::atomic<bool> draining_{false};
   std::atomic<int> active_{0};       // workers currently serving
   std::atomic<int> ready_count_{0};  // mirrors ready_.size() lock-free

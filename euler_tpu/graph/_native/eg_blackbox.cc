@@ -259,8 +259,7 @@ void Blackbox::SamplerLoop() {
 
 bool Blackbox::Install(const std::string& postmortem_dir, int shard,
                        int sample_ms) {
-  static std::mutex install_mu;  // Install is a cold path (init only)
-  std::lock_guard<std::mutex> l(install_mu);
+  std::lock_guard<std::mutex> l(install_mu_);  // cold path (init only)
   shard_.store(shard, std::memory_order_relaxed);
   if (sample_ms > 0)
     sample_ms_.store(sample_ms < 50 ? 50 : sample_ms,
@@ -501,7 +500,12 @@ std::string Blackbox::LiveJson() {
   o.push_back(',');
   AppendKey(&o, "postmortem_dir");
   o.push_back('"');
-  o.append(dir_);
+  {
+    // a concurrent (re-)Install may be swapping dir_ — copy under the
+    // same lock that guards its writes
+    std::lock_guard<std::mutex> l(install_mu_);
+    o.append(dir_);
+  }
   o.push_back('"');
   o.push_back(',');
   AppendKey(&o, "dropped");

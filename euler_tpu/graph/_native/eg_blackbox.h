@@ -45,6 +45,8 @@
 #ifndef EG_BLACKBOX_H_
 #define EG_BLACKBOX_H_
 
+#include "eg_common.h"
+
 #include <atomic>
 #include <cstdint>
 #include <string>
@@ -183,7 +185,10 @@ class Blackbox {
   // error() when the directory is not writable.
   bool Install(const std::string& postmortem_dir, int shard,
                int sample_ms = 0);
-  const std::string& error() const { return error_; }
+  std::string error() const {
+    std::lock_guard<std::mutex> l(install_mu_);
+    return error_;
+  }
   int shard() const { return shard_.load(std::memory_order_relaxed); }
 
   // One fresh resource sample read from /proc (NOT signal-safe; the
@@ -245,8 +250,11 @@ class Blackbox {
   std::atomic<bool> installed_{false};
   std::atomic<int> sample_ms_{1000};
   std::atomic<bool> sampler_running_{false};
-  std::string error_;
-  std::string dir_;
+  // Install/config strings: written only under install_mu_ (Install is
+  // the cold init path); surfaces that read them take the same lock.
+  mutable std::mutex install_mu_;
+  std::string error_ EG_GUARDED_BY(install_mu_);
+  std::string dir_ EG_GUARDED_BY(install_mu_);
 };
 
 }  // namespace eg

@@ -9,13 +9,13 @@
 namespace eg {
 
 std::atomic<int64_t>& GlobalCacheBytes() {
-  static std::atomic<int64_t> bytes{0};
-  return bytes;
+  static std::atomic<int64_t> total{0};
+  return total;
 }
 
 std::atomic<int64_t>& GlobalNbrCacheBytes() {
-  static std::atomic<int64_t> bytes{0};
-  return bytes;
+  static std::atomic<int64_t> total{0};
+  return total;
 }
 
 bool CacheAdmit(int policy, uint64_t candidate, uint64_t victim) {
@@ -38,8 +38,8 @@ FeatureCache::~FeatureCache() {
                                  std::memory_order_relaxed);
 }
 
-void FeatureCache::SetCapacity(size_t bytes) {
-  cap_ = bytes;
+void FeatureCache::SetCapacity(size_t budget) {
+  cap_ = budget;
   if (cap_ != 0) return;
   for (auto& st : stripes_) {
     std::lock_guard<std::mutex> l(st.mu);
@@ -156,8 +156,8 @@ NeighborCache::~NeighborCache() {
                                     std::memory_order_relaxed);
 }
 
-void NeighborCache::SetCapacity(size_t bytes) {
-  cap_ = bytes;
+void NeighborCache::SetCapacity(size_t budget) {
+  cap_ = budget;
   if (cap_ != 0) return;
   for (auto& st : stripes_) {
     std::lock_guard<std::mutex> l(st.mu);

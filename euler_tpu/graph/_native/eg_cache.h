@@ -83,7 +83,7 @@ class FeatureCache {
   ~FeatureCache();  // returns resident bytes to the global gauge
 
   // Total byte budget across stripes; 0 disables (Get misses, Put drops).
-  void SetCapacity(size_t bytes);
+  void SetCapacity(size_t budget);
   bool enabled() const { return cap_ != 0; }
   // Admission policy (CachePolicy); default frequency-aware.
   void SetPolicy(int policy) { policy_ = policy; }
@@ -110,9 +110,10 @@ class FeatureCache {
   };
   struct Stripe {
     mutable std::mutex mu;
-    std::unordered_map<uint64_t, Entry> map;
-    std::deque<uint64_t> fifo;  // insertion order of map keys
-    size_t bytes = 0;
+    std::unordered_map<uint64_t, Entry> map EG_GUARDED_BY(mu);
+    // insertion order of map keys
+    std::deque<uint64_t> fifo EG_GUARDED_BY(mu);
+    size_t bytes EG_GUARDED_BY(mu) = 0;
   };
   static constexpr int kStripes = 16;
   // ~per-entry bookkeeping cost charged against the budget on top of the
@@ -137,7 +138,7 @@ class NeighborCache {
  public:
   ~NeighborCache();  // returns resident bytes to the global gauge
 
-  void SetCapacity(size_t bytes);
+  void SetCapacity(size_t budget);
   bool enabled() const { return cap_ != 0; }
   void SetPolicy(int policy) { policy_ = policy; }
 
@@ -172,9 +173,9 @@ class NeighborCache {
   };
   struct Stripe {
     mutable std::mutex mu;
-    std::unordered_map<uint64_t, Entry> map;
-    std::deque<uint64_t> fifo;
-    size_t bytes = 0;
+    std::unordered_map<uint64_t, Entry> map EG_GUARDED_BY(mu);
+    std::deque<uint64_t> fifo EG_GUARDED_BY(mu);
+    size_t bytes EG_GUARDED_BY(mu) = 0;
   };
   static constexpr int kStripes = 16;
   static constexpr size_t kEntryOverhead = 160;  // 4 vectors + map node

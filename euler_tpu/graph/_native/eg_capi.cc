@@ -820,6 +820,16 @@ void eg_telemetry_record_span(int side, int op, int outcome, int shard,
   EG_API_GUARD()
 }
 
+// Remote liveness probe: one kPing round trip to shard `shard` through
+// the full transport stack (retries/deadline/wire negotiation per the
+// graph's config). 1 = shard answered, 0 = unreachable or bad index.
+int eg_remote_ping(void* h, int shard) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->PingShard(shard) ? 1 : 0;
+  }
+  EG_API_GUARD(0)
+}
+
 // Remote scrape: fetch shard `shard`'s telemetry JSON over the STATS
 // wire opcode (retries/deadline per the graph's transport config). Same
 // buf/cap/return contract as eg_telemetry_json; -1 on transport failure

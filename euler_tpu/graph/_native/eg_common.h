@@ -17,6 +17,28 @@
 #include <string>
 #include <vector>
 
+// Lock-discipline annotation, machine-checked by scripts/check_contracts.py
+// (pass `lock`, rule `guarded-by`): a member declared
+//
+//     std::deque<Conn> ready_ EG_GUARDED_BY(mu_);
+//
+// may only be touched inside a scope holding an RAII guard on `mu_`
+// (std::lock_guard / std::unique_lock / std::scoped_lock), including
+// wait-predicate lambdas whose enclosing unique_lock holds it.
+// Deliberately-unlocked accesses (constructors/destructors are exempt
+// automatically; documented lock-free reads are not) need a reasoned
+// `allow(guarded-by)` escape — check_native.py's eg-lint grammar — on
+// or above the line. Expands to nothing — gcc 10 has no
+// -Wthread-safety — so the checker, not the compiler, enforces it.
+#define EG_GUARDED_BY(mu)
+
+// Companion annotation for helper functions that are only ever called
+// with `mu` already held (the caller locks, the helper touches guarded
+// state freely). The checker exempts the helper's body and instead
+// verifies every CALL SITE holds the guard — same enforcement story as
+// EG_GUARDED_BY: checker, not compiler.
+#define EG_REQUIRES(mu)
+
 namespace eg {
 
 using NodeID = uint64_t;

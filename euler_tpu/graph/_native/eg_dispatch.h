@@ -26,6 +26,8 @@
 #ifndef EG_DISPATCH_H_
 #define EG_DISPATCH_H_
 
+#include "eg_common.h"
+
 #include <condition_variable>
 #include <deque>
 #include <functional>
@@ -70,8 +72,8 @@ class Dispatcher {
 
   mutable std::mutex mu_;  // guards queue_ and stop_
   mutable std::condition_variable cv_;
-  mutable std::deque<Task> queue_;
-  bool stop_ = false;
+  mutable std::deque<Task> queue_ EG_GUARDED_BY(mu_);
+  bool stop_ EG_GUARDED_BY(mu_) = false;
   std::vector<std::thread> threads_;
 };
 

@@ -87,7 +87,8 @@ int Heat::topk_capacity() const {
   return cap_.load(std::memory_order_relaxed);
 }
 
-int Heat::FindSlot(const TopTable& t, uint64_t id, uint64_t h) {
+int Heat::FindSlot(const TopTable& t, uint64_t id, uint64_t h)
+    EG_REQUIRES(mu) {
   for (int probe = 0; probe < kHeatIndexSlots; ++probe) {
     int i = static_cast<int>((h + probe) & (kHeatIndexSlots - 1));
     int32_t v = t.index[i];
@@ -97,7 +98,7 @@ int Heat::FindSlot(const TopTable& t, uint64_t id, uint64_t h) {
   return -1;  // unreachable: the table is never full (load <= 25%)
 }
 
-void Heat::InsertSlot(TopTable* t, uint64_t h, int slot) {
+void Heat::InsertSlot(TopTable* t, uint64_t h, int slot) EG_REQUIRES(mu) {
   for (int probe = 0; probe < kHeatIndexSlots; ++probe) {
     int i = static_cast<int>((h + probe) & (kHeatIndexSlots - 1));
     int32_t v = t->index[i];
@@ -109,7 +110,7 @@ void Heat::InsertSlot(TopTable* t, uint64_t h, int slot) {
   }
 }
 
-void Heat::EraseSlot(TopTable* t, uint64_t id) {
+void Heat::EraseSlot(TopTable* t, uint64_t id) EG_REQUIRES(mu) {
   uint64_t h = Mix(id);
   for (int probe = 0; probe < kHeatIndexSlots; ++probe) {
     int i = static_cast<int>((h + probe) & (kHeatIndexSlots - 1));
@@ -123,13 +124,14 @@ void Heat::EraseSlot(TopTable* t, uint64_t id) {
   }
 }
 
-void Heat::RebuildIndex(TopTable* t) {
+void Heat::RebuildIndex(TopTable* t) EG_REQUIRES(mu) {
   for (auto& c : t->index) c = -1;
   t->tombstones = 0;
   for (int s = 0; s < t->size; ++s) InsertSlot(t, Mix(t->ids[s]), s);
 }
 
-void Heat::UpdateTop(TopTable* t, uint64_t id, uint64_t h, int cap) {
+void Heat::UpdateTop(TopTable* t, uint64_t id, uint64_t h, int cap)
+    EG_REQUIRES(mu) {
   int slot = FindSlot(*t, id, h);
   if (slot >= 0) {
     ++t->counts[slot];
@@ -181,9 +183,9 @@ void Heat::UpdateTop(TopTable* t, uint64_t id, uint64_t h, int cap) {
   InsertSlot(t, h, m);
 }
 
-void Heat::Record(int side, int op, const uint64_t* ids, int64_t n,
+void Heat::Record(int side, int op, const uint64_t* keys, int64_t n,
                   int conn) {
-  RecordRows(side, op, ids, nullptr, n, conn);
+  RecordRows(side, op, keys, nullptr, n, conn);
 }
 
 void Heat::RecordRows(int side, int op, const uint64_t* base,

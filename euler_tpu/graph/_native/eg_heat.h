@@ -48,6 +48,8 @@
 #ifndef EG_HEAT_H_
 #define EG_HEAT_H_
 
+#include "eg_common.h"
+
 #include <atomic>
 #include <cstdint>
 #include <mutex>
@@ -143,7 +145,7 @@ class Heat {
   // Feed one batch of ids (one side, one op, optional server conn).
   // Sketch updates are relaxed atomics per id; the top-K mutex is taken
   // once for the whole batch.
-  void Record(int side, int op, const uint64_t* ids, int64_t n,
+  void Record(int side, int op, const uint64_t* keys, int64_t n,
               int conn = -1);
   // Gather form: feed base[rows[i]] for i in [0, n) — the dense-feature
   // path's unique ids live scattered behind a row-index plan, and
@@ -215,20 +217,20 @@ class Heat {
 
   struct TopTable {
     mutable std::mutex mu;
-    int size = 0;
-    int tombstones = 0;
+    int size EG_GUARDED_BY(mu) = 0;
+    int tombstones EG_GUARDED_BY(mu) = 0;
     // cached minimum level: counts only grow, so any slot whose count
     // equals min_count IS a true minimum — replacements resume a
     // rotating scan at that level instead of an O(cap) argmin per
     // untracked arrival (amortized O(1); a full rescan only when the
     // level is exhausted, which itself raised cap slots one level)
-    uint64_t min_count = 0;
-    int scan_pos = 0;
-    uint64_t ids[kHeatMaxTopK];
-    uint64_t counts[kHeatMaxTopK];
-    uint64_t errs[kHeatMaxTopK];
+    uint64_t min_count EG_GUARDED_BY(mu) = 0;
+    int scan_pos EG_GUARDED_BY(mu) = 0;
+    uint64_t ids[kHeatMaxTopK] EG_GUARDED_BY(mu);
+    uint64_t counts[kHeatMaxTopK] EG_GUARDED_BY(mu);
+    uint64_t errs[kHeatMaxTopK] EG_GUARDED_BY(mu);
     // -1 empty, -2 tombstone, >= 0 slot index
-    int32_t index[kHeatIndexSlots];
+    int32_t index[kHeatIndexSlots] EG_GUARDED_BY(mu);
   };
 
   struct SpreadCell {
@@ -236,11 +238,14 @@ class Heat {
     std::atomic<uint64_t> total;
   };
 
-  static int FindSlot(const TopTable& t, uint64_t id, uint64_t h);
-  static void InsertSlot(TopTable* t, uint64_t h, int slot);
-  static void EraseSlot(TopTable* t, uint64_t id);
-  static void RebuildIndex(TopTable* t);
-  void UpdateTop(TopTable* t, uint64_t id, uint64_t h, int cap);
+  // Slot helpers mutate TopTable freely; callers take t.mu first.
+  static int FindSlot(const TopTable& t, uint64_t id, uint64_t h)
+      EG_REQUIRES(mu);
+  static void InsertSlot(TopTable* t, uint64_t h, int slot) EG_REQUIRES(mu);
+  static void EraseSlot(TopTable* t, uint64_t id) EG_REQUIRES(mu);
+  static void RebuildIndex(TopTable* t) EG_REQUIRES(mu);
+  void UpdateTop(TopTable* t, uint64_t id, uint64_t h, int cap)
+      EG_REQUIRES(mu);
 
   std::atomic<bool> flag_{true};
   std::atomic<int> cap_{kHeatDefaultTopK};

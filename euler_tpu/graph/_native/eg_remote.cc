@@ -709,6 +709,9 @@ bool RemoteGraph::Init(const std::string& config) {
     num_nodes_ += nn;
     num_edges_ += ne;
   }
+  // eg-lint: allow(config-parity) `cfg` here is the shard's registry/kInfo
+  // reply map, not operator config: num_partitions is written by the
+  // partitioner and read back, never a user-facing key.
   if (cfg.count("num_partitions"))
     num_partitions_ = std::stoi(cfg["num_partitions"]);
   if (num_partitions_ <= 0) num_partitions_ = num_shards_;
@@ -816,6 +819,15 @@ bool RemoteGraph::Call(int shard, const std::string& req,
     return false;
   }
   return true;
+}
+
+bool RemoteGraph::PingShard(int shard) const {
+  if (shard < 0 || shard >= num_shards_) return false;
+  WireWriter req;
+  req.U8(kPing);
+  std::string reply;
+  // reply is the bare ok-status byte; Call already validated it
+  return Call(shard, req.buf(), &reply);
 }
 
 bool RemoteGraph::ScrapeShard(int shard, std::string* json) const {

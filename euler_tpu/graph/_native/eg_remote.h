@@ -198,6 +198,12 @@ class RemoteGraph : public GraphAPI {
     return shard >= 0 && shard < num_shards_ ? pools_[shard].num_replicas()
                                              : 0;
   }
+  // Liveness probe of one live shard (kPing opcode): one empty
+  // request/ok-reply round trip through the full transport stack —
+  // retries, deadline and wire-version negotiation included — so a
+  // health checker exercises exactly the path real calls take. False
+  // on transport failure / bad shard index.
+  bool PingShard(int shard) const;
   // Telemetry scrape of one live shard (kStats opcode, eg_telemetry.h):
   // the shard's counters + span-timer stats + latency histograms +
   // admission gauges + slow-span journal as one JSON string — the same

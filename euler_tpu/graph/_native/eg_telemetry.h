@@ -29,6 +29,8 @@
 #ifndef EG_TELEMETRY_H_
 #define EG_TELEMETRY_H_
 
+#include "eg_common.h"
+
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -189,8 +191,8 @@ class Telemetry {
   std::atomic<bool> enabled_{true};
   Cell cells_[kHistKindCount][kHistOpSlots] = {};
   mutable std::mutex span_mu_;  // guards spans_ + span_cap_
-  std::vector<TelemetrySpan> spans_;
-  int span_cap_ = 32;
+  std::vector<TelemetrySpan> spans_ EG_GUARDED_BY(span_mu_);
+  int span_cap_ EG_GUARDED_BY(span_mu_) = 32;
   std::atomic<bool> span_full_{false};
   std::atomic<uint64_t> span_floor_{0};  // min total_us once full
 };

@@ -14,6 +14,10 @@ _lib = None
 
 def build_native(force: bool = False) -> str:
     """Build the native library with make if missing or stale."""
+    if os.environ.get("EG_NATIVE_LIB"):
+        # explicit prebuilt library (scripts/sanitize.sh points this at
+        # an instrumented side build): never rebuild, never second-guess
+        return os.environ["EG_NATIVE_LIB"]
     sources = [
         os.path.join(_NATIVE_DIR, f)
         for f in os.listdir(_NATIVE_DIR)
@@ -53,8 +57,7 @@ def lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    build_native()
-    L = ctypes.CDLL(_LIB_PATH)
+    L = ctypes.CDLL(build_native())
     c = ctypes
     p = c.c_void_p
     u64p = c.POINTER(c.c_uint64)
@@ -95,6 +98,7 @@ def lib() -> ctypes.CDLL:
         [c.c_int, c.c_int, c.c_int, c.c_int, c.c_uint64, c.c_uint64,
          c.c_uint64, c.c_uint64, c.c_uint64],
     )
+    _sig(L.eg_remote_ping, c.c_int, [p, c.c_int])
     _sig(L.eg_remote_scrape, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
     _sig(L.eg_remote_history, c.c_int, [p, c.c_int, c.c_char_p, c.c_int])
     _sig(L.eg_heat_enabled, c.c_int, [])

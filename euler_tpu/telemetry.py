@@ -133,6 +133,16 @@ def scrape(graph, shard: int) -> dict:
     )
 
 
+def ping(graph, shard: int) -> bool:
+    """One kPing round trip to ``shard`` through the full transport
+    stack (retries, deadline, wire negotiation) — the health probe a
+    readiness check should use, because it exercises exactly the path
+    real calls take. True when the shard answered."""
+    if getattr(graph, "mode", None) != "remote":
+        raise ValueError("ping() needs a mode='remote' graph")
+    return lib().eg_remote_ping(graph._h, shard) == 1
+
+
 def telemetry_enabled() -> bool:
     return lib().eg_telemetry_enabled() == 1
 

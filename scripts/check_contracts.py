@@ -1,0 +1,1071 @@
+#!/usr/bin/env python3
+"""Cross-layer contract analyzer for the native graph engine.
+
+check_native.py (whose stripping/brace-matching/escape core this reuses)
+lints single-file *shapes*; the drift it cannot see is CROSS-LAYER — an
+ABI signature, opcode slot, counter name, config key, or lock protocol
+that silently disagrees between eg_capi.cc, native.py, eg_wire.h,
+Service::Dispatch, eg_stats.h, and the docs. Each pass below parses both
+sides of one such contract and diffs them structurally (no libclang —
+every surface involved is regular enough for a line/brace-aware scan).
+
+Passes (each individually testable, see tests/test_contracts.py):
+
+  abi     every `extern "C"` function in eg_capi.cc/eg_api.h has a
+          ctypes `_sig(L.name, ...)` binding in euler_tpu/graph/native.py
+          and vice versa, with matching arity and per-slot type CLASS
+          (pointer vs scalar vs void) — an arity or class mismatch is a
+          silent stack/register misread at call time, not an error.
+  wire    `enum WireOp` (eg_wire.h): opcode values unique;
+          kHistOpSlots == max opcode + 1 and kWireOpNames covers every
+          slot (eg_telemetry.h); every opcode has BOTH a Service::Dispatch
+          `case` (eg_service.cc) and a client-side `U8(kOp)` encoder
+          (eg_remote.cc) — a dispatch-only op is dead server code, an
+          encoder-only op is a guaranteed runtime error.
+  ledger  counter/stat name tables (eg_stats.h): enum count == name-table
+          count, names unique; every counter documented in the FAULTS.md
+          glossary and every glossary row backed by a real counter; the
+          counter names quoted in euler_tpu.counters()' docstring exist.
+  config  config keys parsed by eg_remote.cc / eg_admission.cc vs the
+          README config-key tables, graph.py's `known` kwarg set and
+          run_loop.py flags — an undocumented key is invisible to
+          operators, a documented-but-unparsed key is a silent no-op.
+  lock    every field annotated `EG_GUARDED_BY(mu)` (eg_common.h) is only
+          touched inside a scope holding an RAII guard on that mutex
+          (std::lock_guard / unique_lock / scoped_lock), including
+          wait-predicate lambdas under an enclosing unique_lock;
+          constructors/destructors are exempt (exclusive access).
+  artifacts  no tracked `.o`/`.so`/`.a`/`.flavor` build artifacts; no
+          orphan objects whose source is gone (the stale eg_epoch.o
+          class ROADMAP recorded); .gitignore covers the artifact set.
+
+Escapes: same grammar as check_native.py —
+
+    // eg-lint: allow(<rule>) <reason>      (C++)
+    # eg-lint: allow(<rule>) <reason>       (Python)
+
+on the offending line or the comment run directly above; the reason is
+mandatory. Rule names here: abi-parity, wire-parity, ledger-parity,
+config-parity, guarded-by, artifact-hygiene. Markdown sides (README,
+FAULTS.md) are NOT waivable — fix the doc. A contract escape that no
+longer suppresses anything is itself flagged stale.
+
+Usage:
+    python scripts/check_contracts.py                 # all passes
+    python scripts/check_contracts.py --passes lock,wire
+    python scripts/check_contracts.py --list-passes
+
+Exit codes: 0 clean, 1 violations found, 2 bad invocation / missing file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib.util
+import os
+import re
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_check_native():
+    spec = importlib.util.spec_from_file_location(
+        "check_native", os.path.join(_HERE, "check_native.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_native", mod)  # dataclasses needs the entry
+    spec.loader.exec_module(mod)
+    return mod
+
+
+cn = _load_check_native()
+Violation = cn.Violation
+
+PASSES = {
+    "abi": "extern \"C\" surface vs ctypes _sig bindings (name/arity/type class)",
+    "wire": "WireOp table: unique opcodes, slot count, dispatch + encoder coverage",
+    "ledger": "counter/stat name tables vs FAULTS.md glossary vs Python docstring",
+    "config": "config keys parsed by native/Python vs README tables/run_loop flags",
+    "lock": "EG_GUARDED_BY(mu) fields touched only under their RAII guard",
+    "artifacts": "tracked/orphan build artifacts + .gitignore coverage",
+}
+RULE_OF_PASS = {
+    "abi": "abi-parity",
+    "wire": "wire-parity",
+    "ledger": "ledger-parity",
+    "config": "config-parity",
+    "lock": "guarded-by",
+    "artifacts": "artifact-hygiene",
+}
+CONTRACT_RULES = set(RULE_OF_PASS.values())
+
+PY_ALLOW_RE = re.compile(r"#\s*eg-lint:\s*allow\(([\w-]+)\)\s*(.*)")
+
+
+# ---------------------------------------------------------------------------
+# Shared infrastructure: per-file parse cache + escape-aware reporter
+# ---------------------------------------------------------------------------
+
+
+def strip_comments_keep_strings(text: str) -> str:
+    """Like check_native.strip_comments_and_strings but string literal
+    CONTENT survives (the config/ledger passes diff quoted names)."""
+    out = []
+    i, n = 0, len(text)
+    state = "code"
+    while i < n:
+        c = text[i]
+        nxt = text[i + 1] if i + 1 < n else ""
+        if state == "code":
+            if c == "/" and nxt == "/":
+                state = "line_comment"
+                out.append("  ")
+                i += 2
+                continue
+            if c == "/" and nxt == "*":
+                state = "block_comment"
+                out.append("  ")
+                i += 2
+                continue
+            if c == '"':
+                state = "string"
+            elif c == "'":
+                state = "char"
+            out.append(c)
+        elif state == "line_comment":
+            if c == "\n":
+                state = "code"
+            out.append("\n" if c == "\n" else " ")
+        elif state == "block_comment":
+            if c == "*" and nxt == "/":
+                out.append("  ")
+                i += 2
+                state = "code"
+                continue
+            out.append("\n" if c == "\n" else " ")
+        else:  # string | char
+            if c == "\\":
+                out.append(text[i : i + 2])
+                i += 2
+                continue
+            if (state == "string" and c == '"') or (state == "char" and c == "'"):
+                state = "code"
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+class SourceFile:
+    """One parsed file: raw text, stripped variants, allows, blocks."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, encoding="utf-8", errors="replace") as f:
+            self.text = f.read()
+        if path.endswith(".py"):
+            self.code = self.text  # Python: ast does the real parsing
+            self.allows = {}
+            for ln, line in enumerate(self.text.split("\n"), 1):
+                m = PY_ALLOW_RE.search(line)
+                if m:
+                    self.allows.setdefault(ln, []).append(
+                        (m.group(1), m.group(2).strip())
+                    )
+            self.blocks = []
+            self.code_strings = self.text
+        else:
+            self.code, self.allows = cn.strip_comments_and_strings(self.text)
+            self.blocks = cn.extract_blocks(self.code)
+            self.code_strings = strip_comments_keep_strings(self.text)
+        self.lines = self.code.split("\n")
+
+
+class Checker:
+    """Violation collector with check_native's escape semantics."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.violations: list[Violation] = []
+        self._files: dict[str, SourceFile] = {}
+        self.used_allows: set[tuple[str, int, str]] = set()
+
+    def file(self, *rel) -> SourceFile:
+        path = os.path.join(self.root, *rel)
+        if path not in self._files:
+            self._files[path] = SourceFile(path)
+        return self._files[path]
+
+    def native(self, name: str) -> SourceFile:
+        return self.file("euler_tpu", "graph", "_native", name)
+
+    def rel(self, path: str) -> str:
+        return os.path.relpath(path, self.root)
+
+    def _try_allow(self, sf: SourceFile, cand: int, rule: str) -> bool:
+        for arule, reason in sf.allows.get(cand, []):
+            if arule == rule:
+                self.used_allows.add((sf.path, cand, arule))
+                if not reason:
+                    self.violations.append(
+                        Violation(
+                            self.rel(sf.path),
+                            cand,
+                            "allow-escape",
+                            f"allow({rule}) escape has no reason — justify "
+                            "the exception so it is visible in review",
+                        )
+                    )
+                return True
+        return False
+
+    def report(self, sf: SourceFile | None, line: int, rule: str, message: str):
+        if sf is not None:
+            if self._try_allow(sf, line, rule):
+                return
+            cand = line - 1
+            lines = sf.text.split("\n")
+            while cand >= 1:
+                if self._try_allow(sf, cand, rule):
+                    return
+                if cand <= len(lines) and sf.lines[
+                    min(cand, len(sf.lines)) - 1
+                ].strip():
+                    break  # real code above without a matching allow
+                cand -= 1
+            path = self.rel(sf.path)
+        else:
+            path = "."
+        self.violations.append(Violation(path, line, rule, message))
+
+    def audit_stale_escapes(self, rules=None):
+        """A contract escape that suppressed nothing is itself stale.
+        Only escapes for `rules` (default: all contract rules) are
+        audited — an escape cannot be stale if its pass never ran."""
+        audited = CONTRACT_RULES if rules is None else set(rules)
+        for sf in self._files.values():
+            for ln, entries in sf.allows.items():
+                for arule, _ in entries:
+                    if arule not in audited:
+                        continue  # check_native audits its own rules
+                    if (sf.path, ln, arule) not in self.used_allows:
+                        self.violations.append(
+                            Violation(
+                                self.rel(sf.path),
+                                ln,
+                                "allow-escape",
+                                f"stale escape: allow({arule}) suppresses "
+                                "nothing on this line any more — delete it",
+                            )
+                        )
+
+
+def line_of(code: str, off: int) -> int:
+    return code.count("\n", 0, off) + 1
+
+
+# ---------------------------------------------------------------------------
+# Pass: abi — extern "C" in eg_capi.cc vs _sig bindings in native.py
+# ---------------------------------------------------------------------------
+
+CAPI_FN_RE = re.compile(
+    r"(?:^|[;{}])\s*((?:\w+[\s*&]+)+)(eg_\w+)\s*\(([^)]*)\)\s*\{"
+)
+
+
+def parse_capi_functions(chk: Checker):
+    """(name -> (line, ret_class, [arg_class...])) from extern "C" blocks."""
+    out = {}
+    for fname in ("eg_capi.cc", "eg_api.h"):
+        sf = chk.native(fname)
+        spans = [
+            (b.start, b.end if b.end >= 0 else len(sf.code))
+            for b in sf.blocks
+            if b.kind == "extern"
+        ]
+        for lo, hi in spans:
+            seg = sf.code[lo:hi]
+            for m in CAPI_FN_RE.finditer(seg):
+                ret, name, params = m.group(1), m.group(2), m.group(3)
+                out[name] = (
+                    sf,
+                    line_of(sf.code, lo + m.start(2)),
+                    c_type_class(ret),
+                    [c_type_class(p) for p in split_c_params(params)],
+                )
+    return out
+
+
+def split_c_params(params: str) -> list[str]:
+    s = " ".join(params.split())
+    if not s or s == "void":
+        return []
+    return [p.strip() for p in s.split(",")]
+
+
+def c_type_class(decl: str) -> str:
+    if "*" in decl:
+        return "ptr"
+    if re.fullmatch(r"\s*void\s*", decl):
+        return "void"
+    return "scalar"
+
+
+def parse_py_bindings(chk: Checker):
+    """(name -> (line, ret_class, [arg_class...])) from _sig calls."""
+    sf = chk.file("euler_tpu", "graph", "native.py")
+    tree = ast.parse(sf.text)
+    aliases: dict[str, str] = {}
+    out = {}
+
+    def classify(node) -> str:
+        if isinstance(node, ast.Constant) and node.value is None:
+            return "void"
+        if isinstance(node, ast.Name):
+            return aliases.get(node.id, "scalar")
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("c_void_p", "c_char_p"):
+                return "ptr"
+            return "scalar"
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", "")
+            if fname == "POINTER":
+                return "ptr"
+        return "scalar"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+            node.targets[0], ast.Name
+        ):
+            aliases[node.targets[0].id] = classify(node.value)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_sig"
+            and len(node.args) == 3
+            and isinstance(node.args[0], ast.Attribute)
+        ):
+            name = node.args[0].attr
+            args = node.args[2]
+            argcls = (
+                [classify(a) for a in args.elts]
+                if isinstance(args, ast.List)
+                else None
+            )
+            out[name] = (sf, node.lineno, classify(node.args[1]), argcls)
+    return out
+
+
+def pass_abi(chk: Checker):
+    native = parse_capi_functions(chk)
+    py = parse_py_bindings(chk)
+    for name, (sf, ln, ret, argcls) in sorted(native.items()):
+        if name not in py:
+            chk.report(
+                sf, ln, "abi-parity",
+                f"extern \"C\" `{name}` has no ctypes binding in native.py — "
+                "an unbound symbol is dead ABI surface (or a binding was "
+                "renamed without its symbol)",
+            )
+    for name, (sf, ln, ret, argcls) in sorted(py.items()):
+        if name not in native:
+            chk.report(
+                sf, ln, "abi-parity",
+                f"_sig(L.{name}, ...) binds a symbol that no extern \"C\" "
+                "block defines — this raises AttributeError at lib() time "
+                "(or calls a stale symbol if an old .so is loaded)",
+            )
+            continue
+        nsf, nln, nret, nargs = native[name]
+        if argcls is None:
+            chk.report(
+                sf, ln, "abi-parity",
+                f"_sig(L.{name}, ...) argtypes is not a literal list — the "
+                "analyzer cannot prove the call frame matches "
+                f"{chk.rel(nsf.path)}:{nln}",
+            )
+            continue
+        if len(argcls) != len(nargs):
+            chk.report(
+                sf, ln, "abi-parity",
+                f"_sig(L.{name}) declares {len(argcls)} argument(s) but the "
+                f"native definition at {chk.rel(nsf.path)}:{nln} takes "
+                f"{len(nargs)} — an arity mismatch misreads the call frame "
+                "silently",
+            )
+            continue
+        for i, (pc, ncl) in enumerate(zip(argcls, nargs)):
+            if pc != ncl:
+                chk.report(
+                    sf, ln, "abi-parity",
+                    f"_sig(L.{name}) argument {i} is {pc} but the native "
+                    f"definition at {chk.rel(nsf.path)}:{nln} takes {ncl} — "
+                    "a pointer/scalar class mismatch corrupts the call frame",
+                )
+        if ret != nret:
+            chk.report(
+                sf, ln, "abi-parity",
+                f"_sig(L.{name}) restype class is {ret} but the native "
+                f"definition at {chk.rel(nsf.path)}:{nln} returns {nret}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pass: wire — WireOp enum vs slots vs dispatch vs client encoders
+# ---------------------------------------------------------------------------
+
+
+def parse_enum(sf: SourceFile, enum_name: str):
+    """[(name, value, line)] for a plain C++ enum (explicit or implicit
+    values); None if the enum is not found."""
+    m = re.search(
+        r"enum\s+(?:class\s+)?%s\b[^{]*\{" % re.escape(enum_name), sf.code
+    )
+    if not m:
+        return None
+    body_start = m.end()
+    depth = 1
+    i = body_start
+    while i < len(sf.code) and depth:
+        if sf.code[i] == "{":
+            depth += 1
+        elif sf.code[i] == "}":
+            depth -= 1
+        i += 1
+    body = sf.code[body_start : i - 1]
+    entries = []
+    nxt = 0
+    for item in body.split(","):
+        em = re.search(r"(\w+)\s*(?:=\s*([\w<>x]+))?", item)
+        if not em or not em.group(1):
+            continue
+        name = em.group(1)
+        if em.group(2) is not None:
+            try:
+                val = int(em.group(2), 0)
+            except ValueError:
+                continue  # expression value: out of scope
+        else:
+            val = nxt
+        nxt = val + 1
+        entries.append((name, val, line_of(sf.code, body_start + body.find(item))))
+    return entries
+
+
+def pass_wire(chk: Checker):
+    wire = chk.native("eg_wire.h")
+    ops = parse_enum(wire, "WireOp")
+    if not ops:
+        chk.report(wire, 1, "wire-parity", "enum WireOp not found in eg_wire.h")
+        return
+    seen: dict[int, str] = {}
+    for name, val, ln in ops:
+        if val in seen:
+            chk.report(
+                wire, ln, "wire-parity",
+                f"opcode value {val} of `{name}` duplicates `{seen[val]}` — "
+                "two ops on one wire byte dispatch to whichever came first",
+            )
+        else:
+            seen[val] = name
+    max_op = max(v for _, v, _ in ops)
+
+    tele = chk.native("eg_telemetry.h")
+    sm = re.search(r"kHistOpSlots\s*=\s*(\d+)", tele.code)
+    if not sm:
+        chk.report(tele, 1, "wire-parity", "kHistOpSlots not found")
+    else:
+        slots = int(sm.group(1))
+        if slots != max_op + 1:
+            chk.report(
+                tele, line_of(tele.code, sm.start()), "wire-parity",
+                f"kHistOpSlots is {slots} but max WireOp opcode is {max_op} — "
+                f"per-op histograms need max+1 = {max_op + 1} slots or new "
+                "ops alias slot 0",
+            )
+        nm = re.search(r"kWireOpNames\[[^\]]*\]\s*=\s*\{", tele.code_strings)
+        if nm:
+            seg = tele.code_strings[nm.end() : tele.code_strings.find("}", nm.end())]
+            names = re.findall(r'"([^"]*)"', seg)
+            if len(names) != slots:
+                chk.report(
+                    tele, line_of(tele.code, nm.start()), "wire-parity",
+                    f"kWireOpNames has {len(names)} entries for kHistOpSlots "
+                    f"= {slots} — scrape surfaces index this table by opcode",
+                )
+
+    service = chk.native("eg_service.cc")
+    remote = chk.native("eg_remote.cc")
+    for name, val, ln in ops:
+        if not re.search(r"\bcase\s+%s\s*:" % re.escape(name), service.code):
+            chk.report(
+                wire, ln, "wire-parity",
+                f"opcode `{name}` has no `case {name}:` in Service::Dispatch "
+                "(eg_service.cc) — a client sending it gets the unknown-op "
+                "error from every up-to-date server",
+            )
+        if not re.search(r"\bU8\s*\(\s*%s\s*\)" % re.escape(name), remote.code):
+            chk.report(
+                wire, ln, "wire-parity",
+                f"opcode `{name}` has no client-side `U8({name})` encoder in "
+                "eg_remote.cc — dispatch-only ops are dead server code "
+                "nothing exercises end to end",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pass: ledger — counter/stat tables vs FAULTS.md vs Python surface
+# ---------------------------------------------------------------------------
+
+
+def parse_name_table(sf: SourceFile, table: str) -> tuple[int, list[str]]:
+    m = re.search(r"%s\[[^\]]*\]\s*=\s*\{" % re.escape(table), sf.code_strings)
+    if not m:
+        return -1, []
+    depth = 1
+    i = m.end()
+    while i < len(sf.code_strings) and depth:
+        if sf.code_strings[i] == "{":
+            depth += 1
+        elif sf.code_strings[i] == "}":
+            depth -= 1
+        i += 1
+    seg = sf.code_strings[m.end() : i - 1]
+    return line_of(sf.code_strings, m.start()), re.findall(r'"([^"]*)"', seg)
+
+
+def faults_glossary_counters(chk: Checker) -> tuple[SourceFile, dict[str, int]]:
+    """Counter names from FAULTS.md tables whose header names a counter
+    column; returns {name: line}."""
+    sf = chk.file("FAULTS.md")
+    out: dict[str, int] = {}
+    in_table = False
+    for ln, line in enumerate(sf.text.split("\n"), 1):
+        if re.match(r"\s*\|", line):
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if not in_table:
+                if cells and re.search(r"(?i)\bcounter\b", cells[0]):
+                    in_table = True
+                continue
+            if set("".join(cells)) <= set("-: "):
+                continue  # separator row
+            m = re.match(r"`([\w./]+)`", cells[0])
+            if m:
+                out.setdefault(m.group(1), ln)
+        else:
+            in_table = False
+    return sf, out
+
+
+def pass_ledger(chk: Checker):
+    stats = chk.native("eg_stats.h")
+    counters = parse_enum(stats, "CounterId") or []
+    ctr_entries = [(n, v, ln) for n, v, ln in counters if n != "kCtrCount"]
+    tbl_line, names = parse_name_table(stats, "kCounterNames")
+    if tbl_line < 0:
+        chk.report(stats, 1, "ledger-parity", "kCounterNames table not found")
+        return
+    if len(names) != len(ctr_entries):
+        chk.report(
+            stats, tbl_line, "ledger-parity",
+            f"kCounterNames has {len(names)} entries but enum CounterId has "
+            f"{len(ctr_entries)} (excluding kCtrCount) — every snapshot "
+            "surface indexes names by counter id",
+        )
+    dup = {n for n in names if names.count(n) > 1}
+    if dup:
+        chk.report(
+            stats, tbl_line, "ledger-parity",
+            f"duplicate counter name(s): {', '.join(sorted(dup))} — two ids "
+            "collapse into one dashboard series",
+        )
+    stat_entries = [
+        (n, v, ln)
+        for n, v, ln in (parse_enum(stats, "StatOp") or [])
+        if n != "kStatOpCount"
+    ]
+    stbl_line, stat_names = parse_name_table(stats, "kStatNames")
+    if stbl_line >= 0 and len(stat_names) != len(stat_entries):
+        chk.report(
+            stats, stbl_line, "ledger-parity",
+            f"kStatNames has {len(stat_names)} entries but enum StatOp has "
+            f"{len(stat_entries)} (excluding kStatOpCount)",
+        )
+
+    faults_sf, documented = faults_glossary_counters(chk)
+    for name in names:
+        if name not in documented:
+            chk.report(
+                stats, tbl_line, "ledger-parity",
+                f"counter `{name}` is not in any FAULTS.md counter-glossary "
+                "table — every ledger entry needs operator-facing semantics",
+            )
+    for name, ln in sorted(documented.items()):
+        if name not in names:
+            chk.report(
+                faults_sf, ln, "ledger-parity",
+                f"FAULTS.md documents counter `{name}` that eg_stats.h does "
+                "not define — stale glossary rows misdirect an incident",
+            )
+
+    # counters() docstring name-drops must be real counters
+    py = chk.file("euler_tpu", "graph", "native.py")
+    tree = ast.parse(py.text)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "counters":
+            doc = ast.get_docstring(node) or ""
+            for tok in re.findall(r'"(\w+)":', doc):
+                if tok not in names:
+                    chk.report(
+                        py, node.lineno, "ledger-parity",
+                        f"counters() docstring quotes `\"{tok}\"` which is "
+                        "not a counter in eg_stats.h kCounterNames",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# Pass: config — parsed keys vs README tables vs graph.py vs run_loop
+# ---------------------------------------------------------------------------
+
+
+def readme_config_tables(chk: Checker):
+    """{key: line} from README tables whose header row is |key|default|…."""
+    sf = chk.file("README.md")
+    out: dict[str, int] = {}
+    in_table = False
+    for ln, line in enumerate(sf.text.split("\n"), 1):
+        if re.match(r"\s*\|", line):
+            cells = [c.strip() for c in line.strip().strip("|").split("|")]
+            if not in_table:
+                if cells and cells[0].lower() == "key":
+                    in_table = True
+                continue
+            if set("".join(cells)) <= set("-: "):
+                continue
+            for key in re.findall(r"`(\w+)`", cells[0]):
+                out.setdefault(key, ln)
+        else:
+            in_table = False
+    return sf, out
+
+
+def pass_config(chk: Checker):
+    remote = chk.native("eg_remote.cc")
+    remote_keys: dict[str, int] = {}
+    for m in re.finditer(
+        r'cfg\s*(?:\.\s*(?:count|find|at)\s*\(|\[)\s*"(\w+)"', remote.code_strings
+    ):
+        remote_keys.setdefault(m.group(1), line_of(remote.code_strings, m.start()))
+
+    admission = chk.native("eg_admission.cc")
+    admission_keys: dict[str, int] = {}
+    for m in re.finditer(r'key\s*==\s*"(\w+)"', admission.code_strings):
+        admission_keys.setdefault(
+            m.group(1), line_of(admission.code_strings, m.start())
+        )
+
+    graph = chk.file("euler_tpu", "graph", "graph.py")
+    km = re.search(r"known\s*=\s*\{([^}]*)\}", graph.text)
+    graph_known = set(re.findall(r'"(\w+)"', km.group(1))) if km else set()
+
+    run_loop = chk.file("euler_tpu", "run_loop.py")
+    flags = set(re.findall(r'add_argument\(\s*"--(\w+)"', run_loop.text))
+
+    readme_sf, readme_keys = readme_config_tables(chk)
+    # a key counts as "mentioned" if it appears as a word inside ANY backtick
+    # span (`timeout_ms` inside a compound table cell counts) or inside a
+    # fenced code block; fences are cut first so ``` does not desync the
+    # inline-span regex
+    readme_all = set()
+    fence_re = re.compile(r"```.*?```", re.S)
+    for block in fence_re.findall(readme_sf.text):
+        readme_all.update(re.findall(r"\w+", block))
+    for span in re.findall(r"`([^`\n]+)`", fence_re.sub("", readme_sf.text)):
+        readme_all.update(re.findall(r"\w+", span))
+
+    for key, ln in sorted(remote_keys.items()):
+        if key not in graph_known:
+            chk.report(
+                remote, ln, "config-parity",
+                f"eg_remote.cc parses config key `{key}` that graph.py's "
+                "`known` kwarg set never forwards — unreachable from the "
+                "public Graph surface",
+            )
+        if key not in readme_all:
+            chk.report(
+                remote, ln, "config-parity",
+                f"eg_remote.cc parses config key `{key}` that README.md "
+                "never mentions — operators cannot discover it",
+            )
+    for key, ln in sorted(admission_keys.items()):
+        if key not in readme_all:
+            chk.report(
+                admission, ln, "config-parity",
+                f"service option `{key}` (ParseAdmissionOptions) is not "
+                "documented anywhere in README.md — undiscoverable knob",
+            )
+    parsed_somewhere = (
+        set(remote_keys) | set(admission_keys) | graph_known | flags
+    )
+    for key, ln in sorted(readme_keys.items()):
+        if key not in parsed_somewhere:
+            chk.report(
+                readme_sf, ln, "config-parity",
+                f"README config table documents key `{key}` that nothing "
+                "parses (eg_remote.cc / eg_admission.cc / graph.py known / "
+                "run_loop flags) — a documented no-op",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Pass: lock — EG_GUARDED_BY fields only touched under their guard
+# ---------------------------------------------------------------------------
+
+ANNOT_RE = re.compile(
+    r"\b(\w+)\s*((?:\[[^\][]*\]\s*)*)\s*EG_GUARDED_BY\s*\(\s*(\w+)\s*\)"
+)
+REQUIRES_RE = re.compile(r"EG_REQUIRES\s*\(\s*(\w+)\s*\)")
+GUARD_RE = re.compile(
+    r"\b(?:lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^<>;]*>)?\s+\w+\s*[({]([^;{}]*?)[)}]"
+)
+
+
+def _requires_blocks(sf: SourceFile):
+    """[(mutex, block, fn_name)] for every EG_REQUIRES-marked function
+    DEFINITION (a `;` before the `{` means declaration — no body here)."""
+    out = []
+    for m in REQUIRES_RE.finditer(sf.code):
+        j = m.end()
+        while j < len(sf.code) and sf.code[j] not in ";{":
+            j += 1
+        if j >= len(sf.code) or sf.code[j] == ";":
+            continue
+        for b in sf.blocks:
+            if b.start == j and b.kind == "function":
+                out.append((m.group(1), b, b.name.split("::")[-1]))
+                break
+    return out
+
+
+def _requires_names(sf: SourceFile) -> dict[str, str]:
+    """{function name: mutex} for every EG_REQUIRES-marked declaration or
+    definition in the file (call sites of these must hold the mutex)."""
+    out = {}
+    for m in REQUIRES_RE.finditer(sf.code):
+        head = sf.code[: m.start()]
+        nm = re.search(r"([~\w:]+)\s*\([^()]*\)\s*(?:const\s*)?$", head)
+        if nm:
+            out[nm.group(1).split("::")[-1]] = m.group(1)
+    return out
+
+
+def _guard_covers(region: str, mutex: str) -> bool:
+    """True when some RAII guard on `mutex` declared in `region` (the code
+    from the enclosing function's opening brace to the use site) is still
+    in scope at the end of the region (brace-aware)."""
+    mu_re = re.compile(r"(?:^|[^\w])%s\b" % re.escape(mutex))
+    for g in GUARD_RE.finditer(region):
+        if not mu_re.search(g.group(1)):
+            continue
+        depth = 0
+        ok = True
+        for ch in region[g.end():]:
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth < 0:  # the guard's scope closed before the use
+                    ok = False
+                    break
+        if ok:
+            return True
+    return False
+
+
+def _is_ctor_dtor(chain, use_off, code) -> bool:
+    """Innermost enclosing *function* is a constructor/destructor."""
+    for b in reversed(chain):
+        if b.kind == "lambda":
+            continue
+        if b.kind != "function":
+            return False
+        name = b.name
+        if "~" in name:
+            return True
+        parts = [p for p in name.split("::") if p]
+        if len(parts) >= 2 and parts[-1] == parts[-2]:
+            return True
+        # header-inline ctor: function name equals an enclosing type name
+        for t in chain:
+            if t.kind == "type" and t.name and t.name == name:
+                return True
+        return False
+    return False
+
+
+def pass_lock(chk: Checker):
+    native_dir = os.path.join(chk.root, "euler_tpu", "graph", "_native")
+    files = sorted(
+        f for f in os.listdir(native_dir) if f.endswith((".h", ".cc"))
+    )
+    # collect annotations per file stem
+    annots: dict[str, list[tuple[str, str, int]]] = {}
+    any_annot = False
+    for fname in files:
+        sf = chk.native(fname)
+        for m in ANNOT_RE.finditer(sf.code):
+            ln = line_of(sf.code, m.start())
+            line_text = sf.lines[ln - 1].lstrip()
+            if line_text.startswith("#"):
+                continue  # the macro definition itself
+            stem = fname.rsplit(".", 1)[0]
+            annots.setdefault(stem, []).append((m.group(1), m.group(3), ln))
+            any_annot = True
+    if not any_annot:
+        common = chk.native("eg_common.h")
+        chk.report(
+            common, 1, "guarded-by",
+            "no EG_GUARDED_BY annotations found anywhere — the lock pass "
+            "has nothing to check (macro deleted or annotations stripped?)",
+        )
+        return
+    for stem, fields in sorted(annots.items()):
+        decl_lines = {(f, ln) for f, _, ln in fields}
+        req_names: dict[str, str] = {}
+        req_blocks = []
+        for ext in (".h", ".cc"):
+            fname = stem + ext
+            if fname not in files:
+                continue
+            sf = chk.native(fname)
+            req_names.update(_requires_names(sf))
+            req_blocks.append((sf, _requires_blocks(sf)))
+        for ext in (".h", ".cc"):
+            fname = stem + ext
+            if fname not in files:
+                continue
+            sf = chk.native(fname)
+            sf_req = dict(req_blocks).get(sf, [])
+            for field, mutex in sorted(set((f, m) for f, m, _ in fields)):
+                for um in re.finditer(r"\b%s\b" % re.escape(field), sf.code):
+                    off = um.start()
+                    ln = line_of(sf.code, off)
+                    if (field, ln) in decl_lines and sf.path.endswith(
+                        stem + ".h"
+                    ):
+                        continue  # the annotated declaration itself
+                    tail = sf.code[um.end():um.end() + 2].lstrip()
+                    if tail.startswith("("):
+                        continue  # a method CALL named like the field
+                    chain = [
+                        b
+                        for b in sf.blocks
+                        if b.start < off <= (b.end if b.end >= 0 else len(sf.code))
+                    ]
+                    if not any(
+                        b.kind in ("function", "lambda") for b in chain
+                    ):
+                        continue  # declarations, sizeof, member-init lists
+                    if _is_ctor_dtor(chain, off, sf.code):
+                        continue
+                    if any(
+                        mu == mutex and b.start < off <= b.end
+                        for mu, b, _ in sf_req
+                    ):
+                        continue  # inside an EG_REQUIRES(mu) helper body
+                    outer = next(
+                        b for b in chain if b.kind in ("function", "lambda")
+                    )
+                    region = sf.code[outer.start + 1 : off]
+                    if _guard_covers(region, mutex):
+                        continue
+                    chk.report(
+                        sf, ln, "guarded-by",
+                        f"`{field}` is EG_GUARDED_BY({mutex}) but this scope "
+                        f"holds no RAII guard on {mutex} — lock it or add a "
+                        "reasoned allow(guarded-by) escape for a documented "
+                        "lock-free access",
+                    )
+        # call sites of EG_REQUIRES-marked helpers must themselves hold the
+        # mutex (or sit inside another EG_REQUIRES body for the same mutex)
+        for ext in (".h", ".cc"):
+            fname = stem + ext
+            if fname not in files:
+                continue
+            sf = chk.native(fname)
+            sf_req = dict(req_blocks).get(sf, [])
+            for fn_name, mutex in sorted(req_names.items()):
+                for cm in re.finditer(
+                    r"\b%s\s*\(" % re.escape(fn_name), sf.code
+                ):
+                    off = cm.start()
+                    ln = line_of(sf.code, off)
+                    chain = [
+                        b
+                        for b in sf.blocks
+                        if b.start < off <= (b.end if b.end >= 0 else len(sf.code))
+                    ]
+                    fn_chain = [
+                        b for b in chain if b.kind in ("function", "lambda")
+                    ]
+                    if not fn_chain:
+                        continue  # the declaration/definition header itself
+                    inner = fn_chain[-1]
+                    if inner.kind == "function" and (
+                        inner.name.split("::")[-1] == fn_name
+                    ):
+                        continue  # recursion within the helper itself
+                    if any(
+                        mu == mutex and b.start < off <= b.end
+                        for mu, b, _ in sf_req
+                    ):
+                        continue  # caller is itself EG_REQUIRES(mu)
+                    outer = fn_chain[0]
+                    region = sf.code[outer.start + 1 : off]
+                    if _guard_covers(region, mutex):
+                        continue
+                    chk.report(
+                        sf, ln, "guarded-by",
+                        f"call to `{fn_name}` which is EG_REQUIRES({mutex}) "
+                        f"but this scope holds no RAII guard on {mutex}",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# Pass: artifacts — build-artifact hygiene
+# ---------------------------------------------------------------------------
+
+ARTIFACT_RE = re.compile(r"\.(?:o|so|a)$|(?:^|/)\.flavor$|(?:^|/)\.sanitize/")
+
+
+def pass_artifacts(chk: Checker):
+    try:
+        ls = subprocess.run(
+            ["git", "ls-files"],
+            cwd=chk.root,
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.splitlines()
+    except (OSError, subprocess.CalledProcessError):
+        print(
+            "NOTE: artifacts pass skipped tracked-file check (git unavailable)",
+            file=sys.stderr,
+        )
+        ls = []
+    for path in ls:
+        if ARTIFACT_RE.search(path):
+            chk.violations.append(
+                Violation(
+                    path, 1, "artifact-hygiene",
+                    "build artifact is tracked in git — binaries/flavor "
+                    "markers are machine-local state (make products); "
+                    "`git rm --cached` it",
+                )
+            )
+    native_dir = os.path.join(chk.root, "euler_tpu", "graph", "_native")
+    for fname in sorted(os.listdir(native_dir)):
+        if fname.endswith(".o") and not os.path.exists(
+            os.path.join(native_dir, fname[:-2] + ".cc")
+        ):
+            chk.violations.append(
+                Violation(
+                    chk.rel(os.path.join(native_dir, fname)), 1,
+                    "artifact-hygiene",
+                    f"orphan object: {fname} has no matching .cc — a stale "
+                    "object from a deleted source can shadow real symbols "
+                    "at link time (the eg_epoch.o class); delete it",
+                )
+            )
+    gi_path = os.path.join(chk.root, ".gitignore")
+    patterns = set()
+    if os.path.exists(gi_path):
+        with open(gi_path) as f:
+            patterns = {line.strip() for line in f if line.strip()}
+    gi_sf = None
+    for needed in ("*.o", "*.so", ".flavor", ".sanitize/"):
+        if needed not in patterns:
+            if gi_sf is None:
+                gi_sf = SourceFile(gi_path) if os.path.exists(gi_path) else None
+            chk.violations.append(
+                Violation(
+                    ".gitignore", 1, "artifact-hygiene",
+                    f"missing `{needed}` pattern — freshly built artifacts "
+                    "would show up as untracked noise and invite commits",
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+PASS_FUNCS = {
+    "abi": pass_abi,
+    "wire": pass_wire,
+    "ledger": pass_ledger,
+    "config": pass_config,
+    "lock": pass_lock,
+    "artifacts": pass_artifacts,
+}
+
+
+def run_passes(root: str, passes=None) -> list[Violation]:
+    chk = Checker(root)
+    active = list(passes) if passes else list(PASSES)
+    for name in active:
+        PASS_FUNCS[name](chk)
+    chk.audit_stale_escapes({RULE_OF_PASS[n] for n in active})
+    chk.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return chk.violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument(
+        "--passes", help="comma-separated subset of passes (see --list-passes)"
+    )
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(_HERE),
+        help="repo root (default: the parent of this script's directory)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name, desc in PASSES.items():
+            print(f"{name:10s} [{RULE_OF_PASS[name]}] {desc}")
+        return 0
+
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = [p for p in passes if p not in PASSES]
+        if unknown:
+            print(f"unknown pass(es): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        violations = run_passes(args.root, passes)
+    except FileNotFoundError as e:
+        print(f"cannot read {e.filename}", file=sys.stderr)
+        return 2
+
+    for v in violations:
+        print(v)
+    names = passes or list(PASSES)
+    if violations:
+        print(f"\n{len(violations)} violation(s) across {len(names)} pass(es)")
+        return 1
+    print(f"clean: {len(names)} pass(es) ({', '.join(names)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

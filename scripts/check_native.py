@@ -72,6 +72,18 @@ RULES = {
 
 ALLOW_RE = re.compile(r"eg-lint:\s*allow\(([\w-]+)\)\s*(.*)")
 
+# Rules owned by scripts/check_contracts.py but waived with the SAME escape
+# grammar in the same native files — recognized here so a contract escape is
+# not flagged as a typo; check_contracts audits their use/staleness itself.
+EXTERNAL_RULES = {
+    "abi-parity",
+    "wire-parity",
+    "ledger-parity",
+    "config-parity",
+    "guarded-by",
+    "artifact-hygiene",
+}
+
 
 @dataclasses.dataclass
 class Violation:
@@ -503,19 +515,22 @@ def rule_wire_count_alloc(path, code, blocks, report):
 # ---------------------------------------------------------------------------
 
 
-def lint_text(text: str, path: str, rules=None) -> list[Violation]:
+def lint_text(text: str, path: str, rules=None, stale_out=None) -> list[Violation]:
+    """Lint one file's text. With `stale_out` (a list), every eg-lint escape
+    for a rule owned by THIS linter that did not suppress any violation is
+    appended to it as a Violation — the `--escapes` staleness audit."""
     code, allows = strip_comments_and_strings(text)
     code_lines = code.split("\n")
     blocks = extract_blocks(code)
     violations: list[Violation] = []
     active = set(rules) if rules else set(RULES) - {"allow-escape"}
 
-    used_allows: set[int] = set()
+    used_allows: set[tuple[int, str]] = set()
 
     def check_allow(cand: int, rule: str) -> bool:
         for arule, reason in allows.get(cand, []):
             if arule == rule:
-                used_allows.add(cand)
+                used_allows.add((cand, arule))
                 if not reason:
                     violations.append(
                         Violation(
@@ -564,7 +579,7 @@ def lint_text(text: str, path: str, rules=None) -> list[Violation]:
     # unknown-rule escapes are themselves violations (typo-proofing)
     for ln, entries in allows.items():
         for arule, _ in entries:
-            if arule not in RULES:
+            if arule not in RULES and arule not in EXTERNAL_RULES:
                 violations.append(
                     Violation(
                         path,
@@ -574,13 +589,30 @@ def lint_text(text: str, path: str, rules=None) -> list[Violation]:
                         f"(known: {', '.join(sorted(set(RULES) - {'allow-escape'}))})",
                     )
                 )
+    if stale_out is not None:
+        for ln, entries in allows.items():
+            for arule, _ in entries:
+                if arule in EXTERNAL_RULES or arule not in RULES:
+                    continue  # contract-rule escapes audited by check_contracts
+                if (ln, arule) not in used_allows:
+                    stale_out.append(
+                        Violation(
+                            path,
+                            ln,
+                            "allow-escape",
+                            f"stale escape: allow({arule}) suppresses nothing "
+                            "on this line any more — the waived code is gone "
+                            "or the rule no longer fires here; delete the "
+                            "escape",
+                        )
+                    )
     violations.sort(key=lambda v: (v.line, v.rule))
     return violations
 
 
-def lint_file(path: str, rules=None) -> list[Violation]:
+def lint_file(path: str, rules=None, stale_out=None) -> list[Violation]:
     with open(path, encoding="utf-8", errors="replace") as f:
-        return lint_text(f.read(), path, rules)
+        return lint_text(f.read(), path, rules, stale_out=stale_out)
 
 
 def default_targets(root: str) -> list[str]:
@@ -596,6 +628,13 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", help="files to lint (default: the repo's _native tree)")
     ap.add_argument("--rules", help="comma-separated subset of rules to run")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--escapes",
+        action="store_true",
+        help="audit every eg-lint allow(...) escape: list each one and flag "
+        "stale escapes whose line no longer triggers the waived rule "
+        "(exit 1 when any is stale)",
+    )
     ap.add_argument(
         "--root",
         default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -620,6 +659,41 @@ def main(argv=None) -> int:
     if not targets:
         print("no lint targets found", file=sys.stderr)
         return 2
+
+    if args.escapes:
+        # staleness needs every rule active: a subset would mark escapes
+        # for the disabled rules stale by construction
+        if rules:
+            print("--escapes ignores --rules (full rule set required)",
+                  file=sys.stderr)
+        stale: list[Violation] = []
+        total = 0
+        stale_keys = set()
+        for path in targets:
+            if not os.path.isfile(path):
+                print(f"cannot read {path}", file=sys.stderr)
+                return 2
+            per_file: list[Violation] = []
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+            lint_text(text, path, stale_out=per_file)
+            stale.extend(per_file)
+            stale_keys |= {(v.path, v.line) for v in per_file}
+            _, allows = strip_comments_and_strings(text)
+            for ln in sorted(allows):
+                for arule, reason in allows[ln]:
+                    total += 1
+                    status = "STALE" if (path, ln) in stale_keys and any(
+                        v.line == ln and f"allow({arule})" in v.message
+                        for v in per_file
+                    ) else ("EXTERNAL" if arule in EXTERNAL_RULES else "USED")
+                    print(f"{path}:{ln}: allow({arule}) {status} — {reason}")
+        if stale:
+            print(f"\n{len(stale)} stale escape(s) of {total}")
+            return 1
+        print(f"escape audit clean: {total} escape(s), none stale "
+              "(EXTERNAL = contract rule, audited by check_contracts.py)")
+        return 0
 
     all_violations: list[Violation] = []
     for path in targets:

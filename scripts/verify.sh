@@ -18,6 +18,17 @@ step() { printf '\n== %s ==\n' "$1"; }
 step "native invariant linter (scripts/check_native.py)"
 python scripts/check_native.py || fail=1
 
+step "escape audit (scripts/check_native.py --escapes)"
+# Every `eg-lint: allow(...)` escape must still suppress something —
+# a stale escape is a waiver nobody is using that will waive the NEXT
+# real violation on that line.
+python scripts/check_native.py --escapes || fail=1
+
+step "cross-layer contract analyzer (scripts/check_contracts.py)"
+# ABI/wire/ledger/config parity + lock discipline + artifact hygiene
+# (STATIC_ANALYSIS.md "Cross-layer contracts").
+python scripts/check_contracts.py || fail=1
+
 step "ruff"
 if command -v ruff >/dev/null 2>&1; then
   ruff check euler_tpu scripts tests examples bench.py || fail=1
@@ -135,6 +146,14 @@ step "perf gate (scripts/perf_gate.py — strict for bench_smoke, warn-only remo
 # warn-only. `perf_gate.py --strict` enforces everything.
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python scripts/perf_gate.py --strict-configs bench_smoke || fail=1
+
+step "sanitizer smoke (scripts/sanitize.sh --smoke; SANITIZERS.md)"
+# One TSAN round over the fuzz barrage (16 threads of garbage +
+# concurrent valid traffic against a live service — the densest
+# concurrency per wall-clock second in the tree). The instrumented
+# side build under _native/.sanitize/ is incremental, so this is
+# seconds once warm; the full round set is scripts/sanitize.sh.
+timeout -k 10 600 scripts/sanitize.sh --smoke || fail=1
 
 step "python syntax floor (compileall)"
 # stdlib floor under the optional tools above: at minimum, every file parses

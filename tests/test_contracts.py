@@ -1,0 +1,416 @@
+"""Self-gate for scripts/check_contracts.py (STATIC_ANALYSIS.md
+"Cross-layer contracts").
+
+Two directions, mirroring test_static_analysis.py:
+
+- HEAD is clean: every pass runs violation-free against the real tree,
+  so the analyzer gates verify.sh without a baseline file.
+- Every pass FIRES: each parity pass is proven to detect a seeded drift
+  fixture (renamed ABI fn, duplicated opcode, undocumented counter,
+  undocumented config key, unguarded annotated field, tracked build
+  artifact). A pass that silently stops matching its surface would rot
+  into a vacuous gate — these pin the detection itself.
+
+The drift fixtures copy the minimal real file set into tmp_path and
+mutate it, so they stay faithful to the current tree's shapes instead
+of freezing a synthetic snapshot.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(ROOT, "scripts")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(SCRIPTS, name + ".py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault(name, mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+cc = _load("check_contracts")
+cn = sys.modules["check_native"]  # loaded transitively by check_contracts
+
+NATIVE_REL = os.path.join("euler_tpu", "graph", "_native")
+
+
+def run_pass(root, name):
+    chk = cc.Checker(root)
+    cc.PASS_FUNCS[name](chk)
+    chk.audit_stale_escapes({cc.RULE_OF_PASS[name]})
+    return chk.violations
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    """Minimal copy of the real tree that every pass can run against."""
+    root = str(tmp_path)
+    native_src = os.path.join(ROOT, NATIVE_REL)
+    native_dst = os.path.join(root, NATIVE_REL)
+    os.makedirs(native_dst)
+    for f in os.listdir(native_src):
+        if f.endswith((".h", ".cc")):
+            shutil.copy(os.path.join(native_src, f), native_dst)
+    for rel in (
+        os.path.join("euler_tpu", "graph", "native.py"),
+        os.path.join("euler_tpu", "graph", "graph.py"),
+        os.path.join("euler_tpu", "run_loop.py"),
+        "README.md",
+        "FAULTS.md",
+        ".gitignore",
+    ):
+        dst = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(dst) or root, exist_ok=True)
+        shutil.copy(os.path.join(ROOT, rel), dst)
+    return root
+
+
+def mutate(root, rel, old, new):
+    path = os.path.join(root, rel)
+    with open(path) as f:
+        text = f.read()
+    assert old in text, f"fixture drift: {old!r} not found in {rel}"
+    with open(path, "w") as f:
+        f.write(text.replace(old, new, 1))
+
+
+# ---------------------------------------------------------------------------
+# HEAD is clean
+# ---------------------------------------------------------------------------
+
+
+def test_head_is_clean_per_pass():
+    for name in cc.PASSES:
+        vs = run_pass(ROOT, name)
+        assert vs == [], (
+            f"pass `{name}` dirty on HEAD:\n"
+            + "\n".join(f"{v.path}:{v.line}: {v.message}" for v in vs)
+        )
+
+
+def test_cli_exits_zero_on_head():
+    r = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "check_contracts.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_list_passes():
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(SCRIPTS, "check_contracts.py"),
+            "--list-passes",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0
+    for name in cc.PASSES:
+        assert name in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Each pass fires on seeded drift
+# ---------------------------------------------------------------------------
+
+
+def test_abi_fires_on_renamed_binding(tree):
+    # native.py binds a name whose symbol no longer exists; the real
+    # symbol eg_create loses its binding — both directions must fire.
+    mutate(
+        tree,
+        os.path.join("euler_tpu", "graph", "native.py"),
+        "_sig(L.eg_create,",
+        "_sig(L.eg_create_renamed,",
+    )
+    vs = run_pass(tree, "abi")
+    msgs = "\n".join(v.message for v in vs)
+    assert any(v.rule == "abi-parity" for v in vs)
+    assert "eg_create_renamed" in msgs  # binding with no symbol
+    assert "`eg_create`" in msgs  # symbol with no binding
+
+
+def test_abi_fires_on_arity_mismatch(tree):
+    mutate(
+        tree,
+        os.path.join("euler_tpu", "graph", "native.py"),
+        "_sig(L.eg_remote_ping, c.c_int, [p, c.c_int])",
+        "_sig(L.eg_remote_ping, c.c_int, [p])",
+    )
+    vs = run_pass(tree, "abi")
+    assert any(
+        v.rule == "abi-parity" and "eg_remote_ping" in v.message for v in vs
+    )
+
+
+def test_wire_fires_on_duplicate_opcode(tree):
+    mutate(
+        tree,
+        os.path.join(NATIVE_REL, "eg_wire.h"),
+        "kPing = 1,",
+        "kPing = 1,\n  kPingDupe = 1,",
+    )
+    vs = run_pass(tree, "wire")
+    assert any(
+        v.rule == "wire-parity" and "duplicate" in v.message.lower()
+        for v in vs
+    )
+
+
+def test_wire_fires_on_missing_encoder(tree):
+    # drop the PingShard encoder added for exactly this contract: the
+    # opcode keeps its Dispatch case but loses its client side
+    mutate(
+        tree,
+        os.path.join(NATIVE_REL, "eg_remote.cc"),
+        "req.U8(kPing);",
+        "req.U8(kStats);",
+    )
+    vs = run_pass(tree, "wire")
+    assert any(
+        v.rule == "wire-parity" and "kPing" in v.message for v in vs
+    )
+
+
+def test_ledger_fires_on_undocumented_counter(tree):
+    # delete the `crashes` glossary row: a real counter loses its docs
+    path = os.path.join(tree, "FAULTS.md")
+    with open(path) as f:
+        lines = f.readlines()
+    kept = [ln for ln in lines if not ln.startswith("| `crashes`")]
+    assert len(kept) < len(lines), "fixture drift: crashes row not found"
+    with open(path, "w") as f:
+        f.writelines(kept)
+    vs = run_pass(tree, "ledger")
+    assert any(
+        v.rule == "ledger-parity" and "`crashes`" in v.message for v in vs
+    )
+
+
+def test_ledger_fires_on_phantom_glossary_row(tree):
+    mutate(
+        tree,
+        "FAULTS.md",
+        "| `crashes`",
+        "| `made_up_counter` | never |\n| `crashes`",
+    )
+    vs = run_pass(tree, "ledger")
+    assert any(
+        v.rule == "ledger-parity" and "made_up_counter" in v.message
+        for v in vs
+    )
+
+
+def test_config_fires_on_undocumented_key(tree):
+    mutate(
+        tree,
+        os.path.join(NATIVE_REL, "eg_admission.cc"),
+        'key == "linger_ms"',
+        'key == "secret_knob"',
+    )
+    vs = run_pass(tree, "config")
+    assert any(
+        v.rule == "config-parity" and "secret_knob" in v.message for v in vs
+    )
+
+
+def test_config_fires_on_documented_noop(tree):
+    mutate(
+        tree,
+        "README.md",
+        "| `max_conns` |",
+        "| `bogus_knob` | 0 | documented but parsed nowhere |\n| `max_conns` |",
+    )
+    vs = run_pass(tree, "config")
+    assert any(
+        v.rule == "config-parity" and "bogus_knob" in v.message for v in vs
+    )
+
+
+def test_lock_fires_on_unguarded_field(tree):
+    # a new function touching an EG_GUARDED_BY(mu_) field with no guard
+    with open(os.path.join(tree, NATIVE_REL, "eg_admission.cc"), "a") as f:
+        f.write(
+            "\nnamespace eg {\n"
+            "int DriftProbe(AdmissionServer* s) {\n"
+            "  return stop_ ? 1 : 0;\n"
+            "}\n"
+            "}  // namespace eg\n"
+        )
+    vs = run_pass(tree, "lock")
+    assert any(
+        v.rule == "guarded-by" and "`stop_`" in v.message for v in vs
+    )
+
+
+def test_lock_clean_when_guard_held(tree):
+    with open(os.path.join(tree, NATIVE_REL, "eg_admission.cc"), "a") as f:
+        f.write(
+            "\nnamespace eg {\n"
+            "int GuardedProbe(AdmissionServer* s) {\n"
+            "  std::lock_guard<PosixMutex> l(mu_);\n"
+            "  return stop_ ? 1 : 0;\n"
+            "}\n"
+            "}  // namespace eg\n"
+        )
+    vs = run_pass(tree, "lock")
+    assert vs == [], "\n".join(v.message for v in vs)
+
+
+def test_lock_fires_on_unlocked_requires_call(tree):
+    # calling an EG_REQUIRES(mu) helper without holding mu
+    with open(os.path.join(tree, NATIVE_REL, "eg_heat.cc"), "a") as f:
+        f.write(
+            "\nnamespace eg {\n"
+            "void DriftCall(Heat::TopTable* t) {\n"
+            "  RebuildIndex(t);\n"
+            "}\n"
+            "}  // namespace eg\n"
+        )
+    vs = run_pass(tree, "lock")
+    assert any(
+        v.rule == "guarded-by" and "RebuildIndex" in v.message for v in vs
+    )
+
+
+def test_lock_escape_waives_with_reason(tree):
+    with open(os.path.join(tree, NATIVE_REL, "eg_admission.cc"), "a") as f:
+        f.write(
+            "\nnamespace eg {\n"
+            "int WaivedProbe(AdmissionServer* s) {\n"
+            "  // eg-lint: allow(guarded-by) startup-only read before any "
+            "thread exists\n"
+            "  return stop_ ? 1 : 0;\n"
+            "}\n"
+            "}  // namespace eg\n"
+        )
+    vs = run_pass(tree, "lock")
+    assert vs == [], "\n".join(v.message for v in vs)
+
+
+def test_artifacts_fires_on_tracked_object_and_gitignore_gap(tree):
+    subprocess.run(
+        ["git", "init", "-q"], cwd=tree, check=True, capture_output=True
+    )
+    stale = os.path.join(tree, NATIVE_REL, "eg_epoch.o")
+    with open(stale, "wb") as f:
+        f.write(b"\x7fELF")
+    subprocess.run(
+        ["git", "add", "-f", os.path.join(NATIVE_REL, "eg_epoch.o")],
+        cwd=tree,
+        check=True,
+        capture_output=True,
+    )
+    mutate(tree, ".gitignore", ".sanitize/\n", "")
+    vs = run_pass(tree, "artifacts")
+    msgs = "\n".join(v.message for v in vs)
+    assert any(v.rule == "artifact-hygiene" for v in vs)
+    assert "eg_epoch.o" in msgs  # tracked artifact + orphan object
+    assert ".sanitize/" in msgs  # .gitignore gap
+
+
+def test_stale_contract_escape_is_flagged(tree):
+    # an allow(config-parity) escape on a line that violates nothing
+    mutate(
+        tree,
+        os.path.join(NATIVE_REL, "eg_remote.cc"),
+        'if (cfg.count("num_partitions"))',
+        '// eg-lint: allow(config-parity) testing staleness\n'
+        '  if (true)  // num_partitions parse removed by fixture\n'
+        '  if (cfg.count("num_partitions"))',
+    )
+    # the original escape above the moved parse still matches it, so
+    # seed a DIFFERENT stale one: append an escaped line touching nothing
+    chk = cc.Checker(tree)
+    cc.PASS_FUNCS["config"](chk)
+    chk.audit_stale_escapes({"config-parity"})
+    assert any(
+        v.rule == "allow-escape" and "stale" in v.message for v in chk.violations
+    )
+
+
+# ---------------------------------------------------------------------------
+# check_native --escapes (satellite: stale-escape audit)
+# ---------------------------------------------------------------------------
+
+
+def test_check_native_escapes_clean_on_head():
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(SCRIPTS, "check_native.py"),
+            "--escapes",
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "none stale" in r.stdout
+
+
+def test_check_native_flags_stale_escape():
+    text = (
+        "#include <mutex>\n"
+        "namespace eg {\n"
+        "void F() {\n"
+        "  // eg-lint: allow(raw-lock) suppresses nothing: no raw lock here\n"
+        "  int x = 0;\n"
+        "  (void)x;\n"
+        "}\n"
+        "}  // namespace eg\n"
+    )
+    stale = []
+    cn.lint_text(text, "eg_fake.cc", stale_out=stale)
+    assert stale, "unused own-rule escape must be reported stale"
+    assert any("raw-lock" in v.message for v in stale)
+
+
+def test_external_rule_escape_not_stale():
+    text = (
+        "namespace eg {\n"
+        "void F() {\n"
+        "  // eg-lint: allow(config-parity) audited by check_contracts\n"
+        "  int x = 0;\n"
+        "  (void)x;\n"
+        "}\n"
+        "}  // namespace eg\n"
+    )
+    stale = []
+    cn.lint_text(text, "eg_fake.cc", stale_out=stale)
+    assert stale == [], "contract-rule escapes are not check_native's to audit"
+
+
+# ---------------------------------------------------------------------------
+# sanitize.sh round records (satellite: evidence trail)
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_round_records_are_wellformed():
+    import json
+
+    path = os.path.join(ROOT, "evidence", "sanitizer_rounds", "rounds.jsonl")
+    assert os.path.exists(path), "no recorded sanitizer rounds (run scripts/sanitize.sh)"
+    rows = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert rows
+    for r in rows:
+        assert r["flavor"] in ("tsan", "asan")
+        assert r["verdict"] in ("PASS", "FAIL")
+        assert isinstance(r["reports_first_party"], int)
+    # at least one recorded PASS round of each flavor backs SANITIZERS.md
+    assert any(r["flavor"] == "tsan" and r["verdict"] == "PASS" for r in rows)
