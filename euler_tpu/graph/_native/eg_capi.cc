@@ -213,6 +213,52 @@ int eg_remote_strict_error(void* h, char* buf, int cap) {
   EG_API_GUARD(-1)
 }
 
+// ---- async whole-step sampling (remote graphs only) ----
+// Submit one whole SampleFanout as an in-flight async op on the remote
+// client's dispatcher pool: the hop chain runs as completion
+// continuations (hop h+1's shard jobs are enqueued by hop h's last
+// completing worker), so the calling thread returns immediately and the
+// depth-N step pipeline (euler_tpu/parallel/prefetch.py pipeline(),
+// `sampler_depth=`) can overlap steps k+1..k+N's sampling with step k's
+// H2D + device compute. Same argument shape as eg_sample_fanout; the
+// out_* buffers must stay pinned until eg_remote_async_take returns
+// (graph.py's handle object owns the numpy arrays). Returns a slot
+// handle >= 0, or -1 when the op pool is full / the handle is not a
+// remote graph — callers fall back to the sync eg_sample_fanout.
+int eg_remote_sample_async(void* h, const uint64_t* ids, int n,
+                           const int32_t* etypes_flat,
+                           const int32_t* etype_counts,
+                           const int32_t* counts, int nhops,
+                           uint64_t default_id, uint64_t** out_ids,
+                           float** out_w, int32_t** out_t) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->SampleFanoutAsync(
+        ids, n, etypes_flat, etype_counts, counts, nhops, default_id,
+        out_ids, out_w, out_t);
+  }
+  EG_API_GUARD(-1)
+}
+// 1 = op complete (take will not block), 0 = still running, -1 = bad or
+// free slot. Non-blocking — the pipeline driver polls this to finish
+// steps in submission order without stalling the submit side.
+int eg_remote_async_poll(void* h, int slot) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->PollAsync(slot);
+  }
+  EG_API_GUARD(-1)
+}
+// Block until the op completes, then recycle its slot (0; -1 on a bad
+// or free slot). After this returns the out_* buffers hold the step's
+// sample; shard failures inside the op degraded exactly like the sync
+// path (default rows + rpc_errors, and under strict= the pending
+// eg_remote_strict_error the Python client polls after the take).
+int eg_remote_async_take(void* h, int slot) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->TakeAsync(slot);
+  }
+  EG_API_GUARD(-1)
+}
+
 // ---- graph service (StartService equivalent,
 // reference euler/service/python_api.cc:26-52) ----
 // `options` is the "k=v;k=v" admission spec (workers/pending/max_conns/

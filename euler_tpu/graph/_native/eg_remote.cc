@@ -421,6 +421,14 @@ RemoteGraph::~RemoteGraph() {
     rediscover_stop_.store(true, std::memory_order_release);
     rediscover_thread_.join();
   }
+  // Drain in-flight async ops (SampleFanoutAsync chains): their hop
+  // continuations run on the dispatcher pool and touch this object, so
+  // every chain must reach kDone before the members destruct. A handle
+  // abandoned without TakeAsync only parks its slot until here.
+  {
+    std::unique_lock<std::mutex> l(async_mu_);
+    async_cv_.wait(l, [this] { return async_inflight_ == 0; });
+  }
   // dispatcher_ (a member) destructs after this body: by then no query
   // is in flight, so its queue is empty and the workers join promptly
 }
@@ -1228,17 +1236,15 @@ void RemoteGraph::SampleNodeWithSrc(const uint64_t* src, int n, int count,
   dispatcher_->Run(jobs);
 }
 
-void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
-                                 const int32_t* etypes, int net, int count,
-                                 uint64_t default_id, uint64_t* out_ids,
-                                 float* out_w, int32_t* out_t) const {
-  int64_t total = static_cast<int64_t>(n) * count;
-  std::fill(out_ids, out_ids + total, default_id);
-  std::fill(out_w, out_w + total, 0.f);
-  std::fill(out_t, out_t + total, -1);
-  if (n <= 0 || count <= 0) return;
-  ShardPlan plan;
-  BuildPlan(ids, n, &plan);
+void RemoteGraph::NbrPrep(NbrCall* c) const {
+  int64_t total = static_cast<int64_t>(c->n) * c->count;
+  if (total > 0) {
+    std::fill(c->out_ids, c->out_ids + total, c->default_id);
+    std::fill(c->out_w, c->out_w + total, 0.f);
+    std::fill(c->out_t, c->out_t + total, -1);
+  }
+  if (c->n <= 0 || c->count <= 0) return;
+  BuildPlan(c->ids, c->n, &c->plan);
   // Per-shard staging over the unique entries' draw blocks: unique entry
   // j owns reps[j] * count contiguous draws at rep_off[j] * count; each
   // original row takes the block at (rep_off[pos] + occ) * count, so
@@ -1253,206 +1259,273 @@ void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
   //     cache it, sample locally from the fetched slice;
   //   * FETCH: cold — the plain per-draw wire path, as before.
   Heat& heat = Heat::Global();
-  const bool heat_on = heat.enabled();
-  const bool use_ncache = ncache_.enabled();
-  const uint64_t nspec =
-      use_ncache ? NeighborCache::SpecHash(etypes, net) : 0;
-  uint64_t nbr_hits = 0, nbr_misses = 0;
-  std::vector<std::vector<int64_t>> rep_off(num_shards_);
-  std::vector<std::vector<uint64_t>> sid(num_shards_);
-  std::vector<std::vector<float>> sw(num_shards_);
-  std::vector<std::vector<int32_t>> st(num_shards_);
-  std::vector<std::vector<char>> ok(num_shards_);
+  c->heat_on = heat.enabled();
+  c->use_ncache = ncache_.enabled();
+  c->nspec = c->use_ncache ? NeighborCache::SpecHash(c->etypes, c->net) : 0;
+  c->rep_off.assign(num_shards_, {});
+  c->sid.assign(num_shards_, {});
+  c->sw.assign(num_shards_, {});
+  c->st.assign(num_shards_, {});
+  c->ok.assign(num_shards_, {});
   // unique positions per shard still needing the wire, by path
-  std::vector<std::vector<int32_t>> fetch(num_shards_);
-  std::vector<std::vector<int32_t>> promote(num_shards_);
+  c->fetch.assign(num_shards_, {});
+  c->promote.assign(num_shards_, {});
   for (int s = 0; s < num_shards_; ++s) {
-    size_t m = plan.rows[s].size();
-    rep_off[s].assign(m + 1, 0);
+    size_t m = c->plan.rows[s].size();
+    c->rep_off[s].assign(m + 1, 0);
     for (size_t j = 0; j < m; ++j)
-      rep_off[s][j + 1] = rep_off[s][j] + plan.reps[s][j];
-    size_t draws = static_cast<size_t>(rep_off[s][m]) * count;
-    sid[s].assign(draws, default_id);
-    sw[s].assign(draws, 0.f);
-    st[s].assign(draws, -1);
-    ok[s].assign(m, 0);
+      c->rep_off[s][j + 1] = c->rep_off[s][j] + c->plan.reps[s][j];
+    size_t draws = static_cast<size_t>(c->rep_off[s][m]) * c->count;
+    c->sid[s].assign(draws, c->default_id);
+    c->sw[s].assign(draws, 0.f);
+    c->st[s].assign(draws, -1);
+    c->ok[s].assign(m, 0);
     if (m == 0) continue;
     std::vector<uint64_t> sub(m);
-    for (size_t j = 0; j < m; ++j) sub[j] = ids[plan.rows[s][j]];
+    for (size_t j = 0; j < m; ++j) sub[j] = c->ids[c->plan.rows[s][j]];
     // heat feed: every unique id, post-coalesce but PRE-cache — cache
     // hits are accesses too, and both the promotion gate and the
     // TinyLFU admission read these estimates (this access included)
-    if (heat_on)
+    if (c->heat_on)
       heat.Record(kHeatClient,
                   coalesce_ ? kSampleNeighborUniq : kSampleNeighbor,
                   sub.data(), static_cast<int64_t>(m));
     Rng& rng = ThreadRng();
     for (size_t j = 0; j < m; ++j) {
-      if (use_ncache) {
-        int64_t draws_j = static_cast<int64_t>(plan.reps[s][j]) * count;
-        int64_t dst = rep_off[s][j] * count;
-        if (ncache_.Sample(nspec, sub[j], static_cast<int>(draws_j),
-                           default_id, rng, sid[s].data() + dst,
-                           sw[s].data() + dst, st[s].data() + dst)) {
-          ok[s][j] = 1;
-          ++nbr_hits;
+      if (c->use_ncache) {
+        int64_t draws_j =
+            static_cast<int64_t>(c->plan.reps[s][j]) * c->count;
+        int64_t dst = c->rep_off[s][j] * c->count;
+        if (ncache_.Sample(c->nspec, sub[j], static_cast<int>(draws_j),
+                           c->default_id, rng, c->sid[s].data() + dst,
+                           c->sw[s].data() + dst, c->st[s].data() + dst)) {
+          c->ok[s][j] = 1;
+          ++c->nbr_hits;
           continue;
         }
-        ++nbr_misses;
-        if (heat_on &&
+        ++c->nbr_misses;
+        if (c->heat_on &&
             heat.Estimate(kHeatClient, sub[j]) >= kNbrPromoteMinFreq) {
-          promote[s].push_back(static_cast<int32_t>(j));
+          c->promote[s].push_back(static_cast<int32_t>(j));
           continue;
         }
       }
-      fetch[s].push_back(static_cast<int32_t>(j));
+      c->fetch[s].push_back(static_cast<int32_t>(j));
     }
   }
   Counters& ctr = Counters::Global();
-  if (nbr_hits) ctr.Add(kCtrNbrCacheHit, nbr_hits);
-  if (nbr_misses) ctr.Add(kCtrNbrCacheMiss, nbr_misses);
-  RunChunked(fetch, "sample_neighbor", [&](int s, int32_t b, int32_t e) {
-    int32_t m = e - b;
-    std::vector<uint64_t> sub(static_cast<size_t>(m));
-    std::vector<int32_t> subreps(static_cast<size_t>(m));
-    for (int32_t x = 0; x < m; ++x) {
-      int32_t pos = fetch[s][b + x];
-      sub[x] = ids[plan.rows[s][pos]];
-      subreps[x] = plan.reps[s][pos];
-    }
-    WireWriter req;
-    if (coalesce_) {
-      // dedup'd form: each unique id once, with its repeat count
-      req.U8(kSampleNeighborUniq);
-      req.Arr(sub);
-      req.Arr(subreps);
-    } else {
-      // pre-dedup wire shape (the bench A/B baseline); reps are all 1
-      // here, so the reply layout is identical
-      req.U8(kSampleNeighbor);
-      req.Arr(sub);
-    }
-    req.Arr(etypes, net);
-    req.I32(count);
-    req.U64(default_id);
-    std::string reply;
-    if (!Call(s, req.buf(), &reply)) return false;
-    Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
-    WireReader r(reply);
-    r.U8();
-    int64_t mi, mw, mt;
-    const uint64_t* rid = r.Arr<uint64_t>(&mi);
-    const float* rw = r.Arr<float>(&mw);
-    const int32_t* rt = r.Arr<int32_t>(&mt);
-    int64_t want = 0;
-    for (int32_t x = 0; x < m; ++x)
-      want += static_cast<int64_t>(subreps[x]) * count;
-    if (!r.ok() || mi != want || mw != want || mt != want) return false;
-    // the fetched entries are a subset of the unique list, so their
-    // reply blocks scatter per entry (no contiguous rep_off range)
-    int64_t src = 0;
-    for (int32_t x = 0; x < m; ++x) {
-      int32_t pos = fetch[s][b + x];
-      int64_t draws_x = static_cast<int64_t>(subreps[x]) * count;
-      int64_t dst = rep_off[s][pos] * count;
-      std::copy(rid + src, rid + src + draws_x, sid[s].begin() + dst);
-      std::copy(rw + src, rw + src + draws_x, sw[s].begin() + dst);
-      std::copy(rt + src, rt + src + draws_x, st[s].begin() + dst);
-      ok[s][pos] = 1;
-      src += draws_x;
-    }
-    return true;
-  });
-  if (use_ncache) {
-    RunChunked(
-        promote, "sample_neighbor", [&](int s, int32_t b, int32_t e) {
-          int32_t m = e - b;
-          std::vector<uint64_t> sub(static_cast<size_t>(m));
-          for (int32_t x = 0; x < m; ++x)
-            sub[x] = ids[plan.rows[s][promote[s][b + x]]];
-          WireWriter req;
-          req.U8(kFullNeighbor);
-          req.Arr(sub);
-          req.Arr(etypes, net);
-          req.U8(0);
-          std::string reply;
-          if (!Call(s, req.buf(), &reply)) return false;
-          Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
-          WireReader r(reply);
-          r.U8();
-          EGResult res;
-          if (!ReadResult(&r, &res)) return false;
-          if (res.i32.size() != 2 || res.u64.size() != 1 ||
-              res.f32.size() != 1 ||
-              res.i32[1].size() != static_cast<size_t>(m))
-            return false;
-          int64_t want = 0;
-          for (int32_t x = 0; x < m; ++x) {
-            if (res.i32[1][x] < 0) return false;
-            want += res.i32[1][x];
-          }
-          if (res.u64[0].size() != static_cast<size_t>(want) ||
-              res.f32[0].size() != static_cast<size_t>(want) ||
-              res.i32[0].size() != static_cast<size_t>(want))
-            return false;
-          Rng& rng = ThreadRng();
-          int64_t off = 0;
-          for (int32_t x = 0; x < m; ++x) {
-            int32_t pos = promote[s][b + x];
-            int64_t len = res.i32[1][x];
-            const uint64_t* nid = res.u64[0].data() + off;
-            const float* nw = res.f32[0].data() + off;
-            const int32_t* nt = res.i32[0].data() + off;
-            // cache the slice for every later call (TinyLFU admission
-            // may still refuse it — the draws below don't depend on
-            // that verdict, the slice is in hand either way)
-            ncache_.Put(nspec, sub[x], nid, nw, nt,
-                        static_cast<size_t>(len));
-            int64_t draws_x =
-                static_cast<int64_t>(plan.reps[s][pos]) * count;
-            int64_t dst = rep_off[s][pos] * count;
-            DrawFromSlice(nid, nw, nt, len, draws_x, default_id, rng,
-                          sid[s].data() + dst, sw[s].data() + dst,
-                          st[s].data() + dst);
-            ok[s][pos] = 1;
-            off += len;
-          }
-          return true;
-        });
+  if (c->nbr_hits) ctr.Add(kCtrNbrCacheHit, c->nbr_hits);
+  if (c->nbr_misses) ctr.Add(kCtrNbrCacheMiss, c->nbr_misses);
+}
+
+bool RemoteGraph::NbrFetchChunk(NbrCall* c, int s, int32_t b,
+                                int32_t e) const {
+  int32_t m = e - b;
+  std::vector<uint64_t> sub(static_cast<size_t>(m));
+  std::vector<int32_t> subreps(static_cast<size_t>(m));
+  for (int32_t x = 0; x < m; ++x) {
+    int32_t pos = c->fetch[s][b + x];
+    sub[x] = c->ids[c->plan.rows[s][pos]];
+    subreps[x] = c->plan.reps[s][pos];
   }
+  WireWriter req;
+  if (coalesce_) {
+    // dedup'd form: each unique id once, with its repeat count
+    req.U8(kSampleNeighborUniq);
+    req.Arr(sub);
+    req.Arr(subreps);
+  } else {
+    // pre-dedup wire shape (the bench A/B baseline); reps are all 1
+    // here, so the reply layout is identical
+    req.U8(kSampleNeighbor);
+    req.Arr(sub);
+  }
+  req.Arr(c->etypes, c->net);
+  req.I32(c->count);
+  req.U64(c->default_id);
+  std::string reply;
+  if (!Call(s, req.buf(), &reply)) return false;
+  Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
+  WireReader r(reply);
+  r.U8();
+  int64_t mi, mw, mt;
+  const uint64_t* rid = r.Arr<uint64_t>(&mi);
+  const float* rw = r.Arr<float>(&mw);
+  const int32_t* rt = r.Arr<int32_t>(&mt);
+  int64_t want = 0;
+  for (int32_t x = 0; x < m; ++x)
+    want += static_cast<int64_t>(subreps[x]) * c->count;
+  if (!r.ok() || mi != want || mw != want || mt != want) return false;
+  // the fetched entries are a subset of the unique list, so their
+  // reply blocks scatter per entry (no contiguous rep_off range)
+  int64_t src = 0;
+  for (int32_t x = 0; x < m; ++x) {
+    int32_t pos = c->fetch[s][b + x];
+    int64_t draws_x = static_cast<int64_t>(subreps[x]) * c->count;
+    int64_t dst = c->rep_off[s][pos] * c->count;
+    std::copy(rid + src, rid + src + draws_x, c->sid[s].begin() + dst);
+    std::copy(rw + src, rw + src + draws_x, c->sw[s].begin() + dst);
+    std::copy(rt + src, rt + src + draws_x, c->st[s].begin() + dst);
+    c->ok[s][pos] = 1;
+    src += draws_x;
+  }
+  return true;
+}
+
+bool RemoteGraph::NbrPromoteChunk(NbrCall* c, int s, int32_t b,
+                                  int32_t e) const {
+  int32_t m = e - b;
+  std::vector<uint64_t> sub(static_cast<size_t>(m));
+  for (int32_t x = 0; x < m; ++x)
+    sub[x] = c->ids[c->plan.rows[s][c->promote[s][b + x]]];
+  WireWriter req;
+  req.U8(kFullNeighbor);
+  req.Arr(sub);
+  req.Arr(c->etypes, c->net);
+  req.U8(0);
+  std::string reply;
+  if (!Call(s, req.buf(), &reply)) return false;
+  Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
+  WireReader r(reply);
+  r.U8();
+  EGResult res;
+  if (!ReadResult(&r, &res)) return false;
+  if (res.i32.size() != 2 || res.u64.size() != 1 || res.f32.size() != 1 ||
+      res.i32[1].size() != static_cast<size_t>(m))
+    return false;
+  int64_t want = 0;
+  for (int32_t x = 0; x < m; ++x) {
+    if (res.i32[1][x] < 0) return false;
+    want += res.i32[1][x];
+  }
+  if (res.u64[0].size() != static_cast<size_t>(want) ||
+      res.f32[0].size() != static_cast<size_t>(want) ||
+      res.i32[0].size() != static_cast<size_t>(want))
+    return false;
+  Rng& rng = ThreadRng();
+  int64_t off = 0;
+  for (int32_t x = 0; x < m; ++x) {
+    int32_t pos = c->promote[s][b + x];
+    int64_t len = res.i32[1][x];
+    const uint64_t* nid = res.u64[0].data() + off;
+    const float* nw = res.f32[0].data() + off;
+    const int32_t* nt = res.i32[0].data() + off;
+    // cache the slice for every later call (TinyLFU admission
+    // may still refuse it — the draws below don't depend on
+    // that verdict, the slice is in hand either way)
+    ncache_.Put(c->nspec, sub[x], nid, nw, nt, static_cast<size_t>(len));
+    int64_t draws_x = static_cast<int64_t>(c->plan.reps[s][pos]) * c->count;
+    int64_t dst = c->rep_off[s][pos] * c->count;
+    DrawFromSlice(nid, nw, nt, len, draws_x, c->default_id, rng,
+                  c->sid[s].data() + dst, c->sw[s].data() + dst,
+                  c->st[s].data() + dst);
+    c->ok[s][pos] = 1;
+    off += len;
+  }
+  return true;
+}
+
+void RemoteGraph::NbrBuildJobs(
+    NbrCall* c, std::vector<std::function<void()>>* jobs) const {
+  // Same chunk splitting + counting + failure wrapping as RunChunked,
+  // but emitting into a caller-owned job list: fetch and promote chunks
+  // ride ONE dispatcher batch (their staged writes are disjoint —
+  // rep_off blocks per unique entry, ok[] entries per path, the caches
+  // internally locked), which is what lets the async path treat a whole
+  // slice as a single detached batch with one completion continuation.
+  Counters& ctr = Counters::Global();
+  auto chunked = [&](const std::vector<std::vector<int32_t>>& lists,
+                     bool promote_path) {
+    for (int s = 0; s < static_cast<int>(lists.size()); ++s) {
+      int32_t m = static_cast<int32_t>(lists[s].size());
+      if (m == 0) continue;
+      int32_t step = std::min<int32_t>(chunk_ids_, m);
+      if (m > step)
+        ctr.Add(kCtrRpcChunk, static_cast<uint64_t>((m + step - 1) / step));
+      for (int32_t b = 0; b < m; b += step) {
+        int32_t e = std::min(m, b + step);
+        jobs->emplace_back([this, c, s, b, e, promote_path] {
+          Blackbox::Global().Record(kBbDispatch, 0, s, 0,
+                                    static_cast<uint64_t>(e - b), 0);
+          bool ok = false;
+          try {
+            ok = promote_path ? NbrPromoteChunk(c, s, b, e)
+                              : NbrFetchChunk(c, s, b, e);
+          } catch (...) {
+            // a throwing shard call degrades like a failed one — its
+            // entries keep their prefilled defaults
+            ok = false;
+          }
+          if (!ok) ShardFailed(s, "sample_neighbor");
+        });
+      }
+    }
+  };
+  chunked(c->fetch, false);
+  if (c->use_ncache) chunked(c->promote, true);
+}
+
+void RemoteGraph::NbrFinish(NbrCall* c) const {
   // fan-out attribution (eg_heat.h): ids_on_wire MEASURED as the sum of
   // the per-shard fetch + promote lists (what was actually encoded), so
   // the heat surface's ledger identity (ids_on_wire == ids_requested -
   // ids_deduped - cache_hits) is a real cross-check of the coalescing
   // plan AND the neighbor cache, not a restatement. cache_hits here are
   // NEIGHBOR-cache hits (locally sampled entries).
-  if (heat_on) {
+  if (c->heat_on) {
     uint64_t on_wire = 0;
     int touched = 0;
     for (int s = 0; s < num_shards_; ++s) {
-      uint64_t wire_s = fetch[s].size() + promote[s].size();
+      uint64_t wire_s = c->fetch[s].size() + c->promote[s].size();
       if (wire_s) {
         ++touched;
         on_wire += wire_s;
       }
     }
-    heat.RecordFanout(kSampleNeighbor, static_cast<uint64_t>(n),
-                      static_cast<uint64_t>(plan.coalesced), nbr_hits,
-                      on_wire, touched);
+    Heat::Global().RecordFanout(kSampleNeighbor,
+                                static_cast<uint64_t>(c->n),
+                                static_cast<uint64_t>(c->plan.coalesced),
+                                c->nbr_hits, on_wire, touched);
   }
-  for (int i = 0; i < n; ++i) {
-    int s = plan.shard_of[i];
-    int32_t pos = plan.pos_of[i];
-    if (s < 0 || !ok[s][pos]) continue;
-    int64_t src_off = (rep_off[s][pos] + plan.occ_of[i]) * count;
-    int64_t dst_off = static_cast<int64_t>(i) * count;
-    std::copy(sid[s].begin() + src_off, sid[s].begin() + src_off + count,
-              out_ids + dst_off);
-    std::copy(sw[s].begin() + src_off, sw[s].begin() + src_off + count,
-              out_w + dst_off);
-    std::copy(st[s].begin() + src_off, st[s].begin() + src_off + count,
-              out_t + dst_off);
+  for (int i = 0; i < c->n; ++i) {
+    int s = c->plan.shard_of[i];
+    int32_t pos = c->plan.pos_of[i];
+    if (s < 0 || !c->ok[s][pos]) continue;
+    int64_t src_off = (c->rep_off[s][pos] + c->plan.occ_of[i]) * c->count;
+    int64_t dst_off = static_cast<int64_t>(i) * c->count;
+    std::copy(c->sid[s].begin() + src_off,
+              c->sid[s].begin() + src_off + c->count,
+              c->out_ids + dst_off);
+    std::copy(c->sw[s].begin() + src_off,
+              c->sw[s].begin() + src_off + c->count, c->out_w + dst_off);
+    std::copy(c->st[s].begin() + src_off,
+              c->st[s].begin() + src_off + c->count, c->out_t + dst_off);
   }
+}
+
+void RemoteGraph::SampleNeighbor(const uint64_t* ids, int n,
+                                 const int32_t* etypes, int net, int count,
+                                 uint64_t default_id, uint64_t* out_ids,
+                                 float* out_w, int32_t* out_t) const {
+  // The sync path over the shared phases: the caller's stack holds the
+  // staging and Dispatcher::Run is the completion barrier. Same code
+  // the async hop chain runs, so the two are distribution-identical.
+  NbrCall c;
+  c.ids = ids;
+  c.n = n;
+  c.etypes = etypes;
+  c.net = net;
+  c.count = count;
+  c.default_id = default_id;
+  c.out_ids = out_ids;
+  c.out_w = out_w;
+  c.out_t = out_t;
+  NbrPrep(&c);
+  if (c.n <= 0 || c.count <= 0) return;
+  std::vector<std::function<void()>> jobs;
+  NbrBuildJobs(&c, &jobs);
+  dispatcher_->Run(jobs);
+  NbrFinish(&c);
 }
 
 void RemoteGraph::SampleFanout(const uint64_t* ids, int n,
@@ -1480,6 +1553,169 @@ void RemoteGraph::SampleFanout(const uint64_t* ids, int n,
     cur_n *= counts[h];
     et += etype_counts[h];
   }
+}
+
+namespace {
+
+// SampleFanout's INT_MAX-bounded hop slicing, shared with the async
+// cursor so both paths walk identical (hop, slice) sequences.
+constexpr int64_t kFanoutSlice = int64_t{1} << 30;
+
+// Step op's cursor past the slice just completed: next slice of the
+// same hop, or the first slice of the next hop (the finished hop's
+// output becomes the frontier). Single-writer — see eg_async.h.
+void AdvanceFanoutCursor(AsyncSampleOp* op) {
+  op->slice_off += kFanoutSlice;
+  if (op->slice_off >= op->cur_n) {
+    op->cur = op->out_ids[op->hop];
+    op->cur_n *= op->counts[op->hop];
+    op->et += op->etype_counts[op->hop];
+    ++op->hop;
+    op->slice_off = 0;
+  }
+}
+
+}  // namespace
+
+void RemoteGraph::StartSlice(AsyncSampleOp* op) const {
+  for (;;) {
+    if (op->hop >= op->nhops) {
+      // whole fan-out complete: publish kDone under async_mu_ — the
+      // lock is the happens-before edge to Poll/Take readers of the
+      // output buffers the chain just wrote
+      std::lock_guard<std::mutex> l(async_mu_);
+      op->state = AsyncSampleOp::kDone;
+      --async_inflight_;
+      async_cv_.notify_all();
+      return;
+    }
+    int h = op->hop;
+    int64_t off = op->slice_off;
+    int m = static_cast<int>(
+        std::min<int64_t>(kFanoutSlice, op->cur_n - off));
+    op->call = std::make_unique<NbrCall>();
+    NbrCall* c = op->call.get();
+    c->ids = op->cur + off;
+    c->n = m;
+    c->etypes = op->et;
+    c->net = op->etype_counts[h];
+    c->count = op->counts[h];
+    c->default_id = op->default_id;
+    c->out_ids = op->out_ids[h] + off * op->counts[h];
+    c->out_w = op->out_w[h] + off * op->counts[h];
+    c->out_t = op->out_t[h] + off * op->counts[h];
+    NbrPrep(c);
+    std::vector<std::function<void()>> jobs;
+    if (c->n > 0 && c->count > 0) NbrBuildJobs(c, &jobs);
+    if (!jobs.empty()) {
+      // hop h+1's jobs will be enqueued by THIS batch's completing
+      // worker — never by a blocked caller thread
+      Counters::Global().Add(kCtrAsyncContinuation);
+      dispatcher_->SubmitDetached(std::move(jobs), [this, op] {
+        try {
+          OnSliceDone(op);
+        } catch (...) {
+          // eg-lint thread-catch: never kill the worker — mark the op
+          // done (completed slices are intact, this one keeps its
+          // prefilled defaults) so TakeAsync cannot hang
+          std::lock_guard<std::mutex> l(async_mu_);
+          if (op->state == AsyncSampleOp::kRunning) {
+            op->state = AsyncSampleOp::kDone;
+            --async_inflight_;
+            async_cv_.notify_all();
+          }
+        }
+      });
+      return;
+    }
+    // zero wire work (empty slice, or every unique entry served from
+    // the neighbor cache): finish inline and keep walking the cursor
+    // on this thread — a loop, not recursion, so a deep fully-cached
+    // fan-out cannot grow the stack
+    if (c->n > 0 && c->count > 0) NbrFinish(c);
+    op->call.reset();
+    AdvanceFanoutCursor(op);
+  }
+}
+
+void RemoteGraph::OnSliceDone(AsyncSampleOp* op) const {
+  NbrFinish(op->call.get());
+  op->call.reset();
+  AdvanceFanoutCursor(op);
+  StartSlice(op);
+}
+
+int RemoteGraph::SampleFanoutAsync(const uint64_t* ids, int n,
+                                   const int32_t* etypes_flat,
+                                   const int32_t* etype_counts,
+                                   const int32_t* counts, int nhops,
+                                   uint64_t default_id, uint64_t** out_ids,
+                                   float** out_w, int32_t** out_t) const {
+  if (n < 0 || nhops <= 0 || !dispatcher_) return -1;
+  int slot = -1;
+  {
+    std::lock_guard<std::mutex> l(async_mu_);
+    for (int i = 0; i < kMaxAsyncOps; ++i) {
+      if (async_ops_[i].state == AsyncSampleOp::kFree) {
+        slot = i;
+        break;
+      }
+    }
+    if (slot < 0) return -1;  // pool full: the caller degrades to sync
+    async_ops_[slot].state = AsyncSampleOp::kRunning;
+    ++async_inflight_;
+    Counters::Global().Add(kCtrAsyncSubmit);
+    Counters::Global().Max(kCtrAsyncInflightPeak,
+                           static_cast<uint64_t>(async_inflight_));
+  }
+  AsyncSampleOp& op = async_ops_[slot];
+  // deep-copy the request: the submitting frame (a ctypes call from the
+  // Python pipeline driver) unwinds immediately; outputs stay borrowed
+  // (the caller pins them until TakeAsync)
+  int net_total = 0;
+  for (int h = 0; h < nhops; ++h) net_total += etype_counts[h];
+  op.ids.assign(ids, ids + n);
+  op.etypes_flat.assign(etypes_flat, etypes_flat + net_total);
+  op.etype_counts.assign(etype_counts, etype_counts + nhops);
+  op.counts.assign(counts, counts + nhops);
+  op.n = n;
+  op.nhops = nhops;
+  op.default_id = default_id;
+  op.out_ids.assign(out_ids, out_ids + nhops);
+  op.out_w.assign(out_w, out_w + nhops);
+  op.out_t.assign(out_t, out_t + nhops);
+  op.hop = 0;
+  op.slice_off = 0;
+  op.cur_n = n;
+  op.cur = op.ids.data();
+  op.et = op.etypes_flat.data();
+  StartSlice(&op);
+  return slot;
+}
+
+int RemoteGraph::PollAsync(int slot) const {
+  if (slot < 0 || slot >= kMaxAsyncOps) return -1;
+  std::lock_guard<std::mutex> l(async_mu_);
+  int st = async_ops_[slot].state;
+  if (st == AsyncSampleOp::kFree) return -1;
+  return st == AsyncSampleOp::kDone ? 1 : 0;
+}
+
+int RemoteGraph::TakeAsync(int slot) const {
+  if (slot < 0 || slot >= kMaxAsyncOps) return -1;
+  std::unique_lock<std::mutex> l(async_mu_);
+  AsyncSampleOp& op = async_ops_[slot];
+  if (op.state == AsyncSampleOp::kFree) return -1;
+  async_cv_.wait(l, [&op] { return op.state == AsyncSampleOp::kDone; });
+  op.state = AsyncSampleOp::kFree;
+  // drop the owned request copies now, not at the next submit — a
+  // paused pipeline should not pin a step's id arrays indefinitely
+  op.ids = {};
+  op.etypes_flat = {};
+  op.out_ids = {};
+  op.out_w = {};
+  op.out_t = {};
+  return 0;
 }
 
 namespace {

@@ -32,6 +32,7 @@
 #define EG_REMOTE_H_
 
 #include <atomic>
+#include <condition_variable>
 #include <functional>
 #include <map>
 #include <memory>
@@ -42,6 +43,7 @@
 #include <vector>
 
 #include "eg_api.h"
+#include "eg_async.h"
 #include "eg_cache.h"
 #include "eg_dispatch.h"
 #include "eg_engine.h"
@@ -230,6 +232,29 @@ class RemoteGraph : public GraphAPI {
   void RouteShards(const uint64_t* ids, int n, int32_t* out) const {
     for (int i = 0; i < n; ++i) out[i] = ShardOf(ids[i]);
   }
+  // ---- Async whole-step sampling (the eg_remote_sample_async ABI) ----
+  // Submit one whole SampleFanout as an in-flight async op: returns a
+  // slot handle >= 0, or -1 when all kMaxAsyncOps slots are busy (the
+  // caller falls back to the sync path). The request arrays are COPIED;
+  // the per-hop output buffers are borrowed and must stay pinned until
+  // TakeAsync returns. The hop chain runs entirely on the dispatcher
+  // pool: hop h+1's jobs are enqueued by hop h's completion continuation
+  // (Dispatcher::SubmitDetached), never by a blocked caller thread —
+  // `async_submits` / `async_inflight_peak` / `async_continuations`
+  // count the pipeline's shape.
+  int SampleFanoutAsync(const uint64_t* ids, int n,
+                        const int32_t* etypes_flat,
+                        const int32_t* etype_counts, const int32_t* counts,
+                        int nhops, uint64_t default_id, uint64_t** out_ids,
+                        float** out_w, int32_t** out_t) const;
+  // 1 = complete, 0 = still running, -1 = bad/free slot. Non-blocking.
+  int PollAsync(int slot) const;
+  // Block until the op completes, then recycle its slot (0; -1 on a
+  // bad/free slot). Shard failures inside the op degrade exactly like
+  // the sync path: default rows + rpc_errors, and under strict= the
+  // pending error the Python client polls after the take.
+  int TakeAsync(int slot) const;
+
   // Pending strict-mode failure: copies + clears the first recorded
   // message. Empty string = no pending failure. (The fixed-shape query
   // ABI returns void, so strict failures surface through this side
@@ -292,19 +317,7 @@ class RemoteGraph : public GraphAPI {
                                  const int32_t* fids, int nf) const override;
 
  private:
-  // How one request's ids scatter to shards after (optional) coalescing:
-  // per shard the unique ids' first-occurrence row list plus per-entry
-  // duplicate counts, and for every ORIGINAL row the (shard, unique
-  // position, occurrence index) it resolves to — the row maps replies
-  // scatter back through.
-  struct ShardPlan {
-    std::vector<std::vector<int32_t>> rows;  // [shard] -> unique rows
-    std::vector<std::vector<int32_t>> reps;  // [shard] -> dup count/unique
-    std::vector<int32_t> shard_of;           // [orig row]
-    std::vector<int32_t> pos_of;             // [orig row] -> unique pos
-    std::vector<int32_t> occ_of;             // [orig row] -> occurrence
-    int64_t coalesced = 0;                   // rows removed from the wire
-  };
+  // ShardPlan lives in eg_async.h now (the async op state embeds one);
   // Build the plan (dedup when coalesce=1; identity grouping otherwise).
   // Adds `coalesced` to the ids_deduped counter.
   void BuildPlan(const uint64_t* ids, int n, ShardPlan* plan) const;
@@ -364,6 +377,36 @@ class RemoteGraph : public GraphAPI {
       const;
   // Weighted multinomial draw of a shard per sample; type==-1 uses totals.
   void DrawShards(bool edges, int32_t type, int count, int* out) const;
+
+  // ---- SampleNeighbor phases (shared by the sync + async paths) ----
+  // The former monolithic SampleNeighbor body, split at its natural
+  // barriers so the async hop chain can run the middle phase as a
+  // detached dispatcher batch. Sync SampleNeighbor is now literally
+  // Prep + BuildJobs + dispatcher Run + Finish over a stack NbrCall.
+  // Prefill outputs, build the shard plan, split unique entries into
+  // CACHED (served locally now) / PROMOTE / FETCH, size the staging.
+  void NbrPrep(NbrCall* c) const;
+  // One wire chunk of the FETCH (kSampleNeighbor[Uniq]) / PROMOTE
+  // (kFullNeighbor + cache + local draw) lists; false on failure
+  // (affected entries keep defaults). Run on dispatcher workers.
+  bool NbrFetchChunk(NbrCall* c, int s, int32_t b, int32_t e) const;
+  bool NbrPromoteChunk(NbrCall* c, int s, int32_t b, int32_t e) const;
+  // Emit the chunked fetch + promote jobs (one combined batch — their
+  // writes are disjoint) with the standard failure wrapping; counts
+  // rpc_chunks exactly like RunChunked.
+  void NbrBuildJobs(NbrCall* c,
+                    std::vector<std::function<void()>>* jobs) const;
+  // Heat fan-out attribution + scatter staged draws to the output rows.
+  void NbrFinish(NbrCall* c) const;
+
+  // ---- async hop chain ----
+  // Drive op forward from its cursor: prep slices until one has wire
+  // work (submit it detached with an OnSliceDone continuation and
+  // return) or the fan-out completes (mark kDone, wake waiters).
+  void StartSlice(AsyncSampleOp* op) const;
+  // Continuation body: finish the completed slice, advance the cursor,
+  // keep driving.
+  void OnSliceDone(AsyncSampleOp* op) const;
   // Gather merges for variable-length sub-results (ordered re-assembly, the
   // role of the reference's MergeCallback, remote_graph.cc:241-261),
   // scattering each shard's per-unique-row segments back to every
@@ -418,6 +461,16 @@ class RemoteGraph : public GraphAPI {
   bool placement_enabled_ = true;  // placement= config key
   mutable std::mutex strict_mu_;        // guards strict_error_
   mutable std::string strict_error_;    // first pending strict failure
+  // Async op slot pool (SampleFanoutAsync). Sized for the pipeline's
+  // worst case — sampler_depth in-flight steps plus poll-side slack —
+  // not for generality; a full pool answers -1 and the caller degrades
+  // to sync. async_mu_ guards every op's `state` and the in-flight
+  // count; the cv wakes TakeAsync waiters and the draining destructor.
+  static constexpr int kMaxAsyncOps = 8;
+  mutable std::mutex async_mu_;
+  mutable std::condition_variable async_cv_;
+  mutable AsyncSampleOp async_ops_[kMaxAsyncOps];
+  mutable int async_inflight_ EG_GUARDED_BY(async_mu_) = 0;
   // Cross-shard samplers: per type a table over shards, plus totals tables.
   std::vector<PrefixTable> node_shard_by_type_, edge_shard_by_type_;
   PrefixTable node_shard_total_, edge_shard_total_;

@@ -186,6 +186,17 @@ enum CounterId : int {
   kCtrServeRecompile,
   kCtrH2dBytes,
   kCtrD2hBytes,
+  // Async-sampler ledger (eg_remote.cc SampleFanoutAsync): the
+  // completion-queue pipeline's shape. async_submits counts whole-step
+  // async ops submitted; async_inflight_peak is a high-water mark (via
+  // Counters::Max) of ops concurrently in flight — at sampler_depth=N
+  // it should read N, proving the pipeline really overlapped;
+  // async_continuations counts hop/slice continuations fired on the
+  // dispatcher pool (jobs enqueued by a completing worker, never by a
+  // blocked caller — the mechanism of arXiv 2110.08450's overlap).
+  kCtrAsyncSubmit,
+  kCtrAsyncInflightPeak,
+  kCtrAsyncContinuation,
   kCtrCount,
 };
 
@@ -204,6 +215,7 @@ const char* const kCounterNames[kCtrCount] = {
     "serve_deadline_rejects", "serve_batches",
     "device_compiles",    "device_recompiles",
     "serve_recompiles",   "h2d_bytes",        "d2h_bytes",
+    "async_submits",      "async_inflight_peak", "async_continuations",
 };
 
 class Counters {
@@ -215,6 +227,16 @@ class Counters {
 
   void Add(CounterId id, uint64_t n = 1) {
     cells_[id].fetch_add(n, std::memory_order_relaxed);
+  }
+
+  // CAS-max for high-water-mark counters (async_inflight_peak): the
+  // cell monotonically tracks the largest value ever reported.
+  void Max(CounterId id, uint64_t v) {
+    uint64_t prev = cells_[id].load(std::memory_order_relaxed);
+    while (prev < v &&
+           !cells_[id].compare_exchange_weak(prev, v,
+                                             std::memory_order_relaxed)) {
+    }
   }
 
   uint64_t Get(CounterId id) const {

@@ -759,6 +759,56 @@ class Graph:
             out_t,
         )
 
+    def sample_fanout_async(
+        self, ids, edge_types, counts, default_node: int = -1
+    ):
+        """Submit one whole multi-hop sample as an in-flight async op.
+
+        Remote graphs only. The native hop chain runs entirely on the
+        client's dispatcher pool (hop h+1's shard jobs are enqueued by
+        hop h's completion continuation), so this returns immediately
+        with an :class:`AsyncFanout` handle — ``poll()`` it, then
+        ``take()`` for the same (ids_per_hop, weights, types) tuple
+        ``sample_fanout`` returns. The handle owns every buffer the
+        native op writes into; keep it referenced until the take.
+
+        Returns None when the native async-op pool is full or the graph
+        is not remote — callers fall back to the sync ``sample_fanout``
+        (the depth pipeline in euler_tpu/parallel/prefetch.py does this
+        transparently).
+        """
+        if self.mode != "remote":
+            return None
+        ids = _ids(ids)
+        nhops = len(counts)
+        et_lists = [_i32(e) for e in edge_types]
+        et_flat = (
+            np.concatenate(et_lists) if et_lists else np.zeros(0, np.int32)
+        )
+        et_counts = _i32([len(e) for e in et_lists])
+        counts_arr = _i32(counts)
+        out_i, out_w, out_t = [], [], []
+        m = len(ids)
+        for h in range(nhops):
+            m *= int(counts[h])
+            out_i.append(np.empty(m, dtype=np.uint64))
+            out_w.append(np.empty(m, dtype=np.float32))
+            out_t.append(np.empty(m, dtype=np.int32))
+        ids_ptrs = (_U64P * nhops)(*[_ptr(a, _U64P) for a in out_i])
+        w_ptrs = (_F32P * nhops)(*[_ptr(a, _F32P) for a in out_w])
+        t_ptrs = (_I32P * nhops)(*[_ptr(a, _I32P) for a in out_t])
+        slot = self._lib.eg_remote_sample_async(
+            self._h, _ptr(ids, _U64P), len(ids), _ptr(et_flat, _I32P),
+            _ptr(et_counts, _I32P), _ptr(counts_arr, _I32P), nhops,
+            _default_u64(default_node), ids_ptrs, w_ptrs, t_ptrs,
+        )
+        if slot < 0:
+            return None
+        return AsyncFanout(
+            self, slot, ids, et_flat, et_counts, counts_arr,
+            out_i, out_w, out_t,
+        )
+
     def get_full_neighbor(self, ids, edge_types, sorted: bool = False):
         """Ragged full adjacency: (nbr_ids, weights, types, row_counts)."""
         ids = _ids(ids)
@@ -940,3 +990,66 @@ class Graph:
             self._lib.eg_result_free(r)
         self._check_strict()
         return out
+
+
+class AsyncFanout:
+    """Handle of one in-flight async multi-hop sample
+    (:meth:`Graph.sample_fanout_async`).
+
+    Owns every buffer the native op writes into (the request arrays are
+    copied native-side, but the per-hop outputs are written in place),
+    so the handle must stay referenced until :meth:`take` returns. One
+    take per handle; the native slot recycles on take.
+    """
+
+    def __init__(self, graph, slot, ids, et_flat, et_counts, counts_arr,
+                 out_i, out_w, out_t):
+        self._graph = graph
+        self._slot = slot
+        self._ids = ids
+        # pinned until the take: the native op borrows these buffers
+        self._pin = (et_flat, et_counts, counts_arr)
+        self._out_i = out_i
+        self._out_w = out_w
+        self._out_t = out_t
+        self._taken = False
+
+    def poll(self) -> bool:
+        """True when the op has completed (take will not block)."""
+        if self._taken:
+            return True
+        return self._graph._lib.eg_remote_async_poll(
+            self._graph._h, self._slot) == 1
+
+    def take(self):
+        """Block until the op completes, recycle its native slot, and
+        return the same (ids_per_hop, weights_per_hop, types_per_hop)
+        tuple ``sample_fanout`` returns. Raises under ``strict=`` when
+        a shard failed inside the op — identical semantics to the sync
+        path, just surfaced at the take instead of the call."""
+        if self._taken:
+            raise RuntimeError("AsyncFanout.take() called twice")
+        rc = self._graph._lib.eg_remote_async_take(
+            self._graph._h, self._slot)
+        self._taken = True
+        if rc != 0:
+            raise RuntimeError(
+                "eg_remote_async_take failed for slot %d" % self._slot)
+        self._graph._check_strict()
+        return (
+            [self._ids.view(np.int64)]
+            + [a.view(np.int64) for a in self._out_i],
+            self._out_w,
+            self._out_t,
+        )
+
+    def __del__(self):
+        # an abandoned handle must not leak its native slot (and the op
+        # may still be writing into our buffers): block for completion
+        try:
+            if not self._taken:
+                self._graph._lib.eg_remote_async_take(
+                    self._graph._h, self._slot)
+                self._taken = True
+        except Exception:
+            pass

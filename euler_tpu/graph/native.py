@@ -134,6 +134,16 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_remote_route, None, [p, u64p, c.c_int, i32p])
     _sig(L.eg_remote_strict_error, c.c_int, [p, c.c_char_p, c.c_int])
     _sig(
+        L.eg_remote_sample_async,
+        c.c_int,
+        [
+            p, u64p, c.c_int, i32p, i32p, i32p, c.c_int, c.c_uint64,
+            c.POINTER(u64p), c.POINTER(f32p), c.POINTER(i32p),
+        ],
+    )
+    _sig(L.eg_remote_async_poll, c.c_int, [p, c.c_int])
+    _sig(L.eg_remote_async_take, c.c_int, [p, c.c_int])
+    _sig(
         L.eg_service_start,
         p,
         [c.c_char_p, c.c_int, c.c_int, c.c_char_p, c.c_int, c.c_char_p,

@@ -210,6 +210,22 @@ class Model:
     def sample_embed(self, graph, inputs) -> dict:
         return self.sample(graph, inputs)
 
+    # ---- split sampling (the sampler_depth pipeline's model API) ----
+    # The depth-N step pipeline (euler_tpu/parallel/prefetch.py
+    # pipeline(), train.py sampler_depth=) needs sampling split at its
+    # blocking point: sample_start submits the step's graph queries
+    # WITHOUT waiting (remote graphs: one eg_remote_sample_async op
+    # whose hop chain runs on the native dispatcher pool) and returns an
+    # opaque pending token; sample_finish blocks on that token and
+    # builds the batch. The defaults keep every model correct — start
+    # does the whole synchronous sample and finish just unwraps — so
+    # only models with an async fast path (SupervisedGraphSage) override.
+    def sample_start(self, graph, inputs):
+        return self.sample(graph, inputs)
+
+    def sample_finish(self, graph, pending) -> dict:
+        return pending
+
     # ---- device-resident sampling (euler_tpu/graph/device.py) ----
     def init_device_sampling(
         self, device_sampling: bool, require_features: bool = True

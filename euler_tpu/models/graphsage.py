@@ -168,6 +168,15 @@ class SupervisedGraphSage(base.Model):
             )
         return consts
 
+    def _batch_from_hops(self, graph, inputs, ids_per_hop) -> dict:
+        hops = [self.node_inputs(graph, ids) for ids in ids_per_hop]
+        if self.device_features:
+            return {"hops": hops}  # labels gathered on device from consts
+        labels = graph.get_dense_feature(
+            inputs, [self.label_idx], [self.label_dim]
+        )
+        return {"hops": hops, "labels": labels}
+
     def sample(self, graph, inputs) -> dict:
         inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
         if self.device_sampling:
@@ -177,13 +186,39 @@ class SupervisedGraphSage(base.Model):
         ids_per_hop, _, _ = graph.sample_fanout(
             inputs, self.metapath, self.fanouts, self.default_node
         )
-        hops = [self.node_inputs(graph, ids) for ids in ids_per_hop]
-        if self.device_features:
-            return {"hops": hops}  # labels gathered on device from consts
-        labels = graph.get_dense_feature(
-            inputs, [self.label_idx], [self.label_dim]
+        return self._batch_from_hops(graph, inputs, ids_per_hop)
+
+    def sample_start(self, graph, inputs):
+        """Non-blocking half of sample() for the sampler_depth pipeline:
+        submit the whole fan-out as one native async op (hop chain on
+        the remote client's dispatcher pool) and return immediately.
+        Falls back to the synchronous sample() whenever the graph has no
+        async path (local mode, mock graphs) or the native op pool is
+        momentarily full — the pipeline then still works, just without
+        native overlap for that step."""
+        inputs = np.asarray(inputs, dtype=np.int64).reshape(-1)
+        if self.device_sampling:
+            return self.device_sample_batch(inputs)
+        start = getattr(graph, "sample_fanout_async", None)
+        handle = (
+            start(inputs, self.metapath, self.fanouts, self.default_node)
+            if start is not None
+            else None
         )
-        return {"hops": hops, "labels": labels}
+        if handle is None:
+            return self.sample(graph, inputs)
+        return (inputs, handle)
+
+    def sample_finish(self, graph, pending) -> dict:
+        if not (
+            isinstance(pending, tuple)
+            and len(pending) == 2
+            and hasattr(pending[1], "take")
+        ):
+            return pending  # sample_start already produced the batch
+        inputs, handle = pending
+        ids_per_hop, _, _ = handle.take()
+        return self._batch_from_hops(graph, inputs, ids_per_hop)
 
 
 class _ScalableSageModule(nn.Module):

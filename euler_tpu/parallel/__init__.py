@@ -13,7 +13,7 @@ from euler_tpu.parallel.mesh import (
     state_sharding,
     table_sharding,
 )
-from euler_tpu.parallel.prefetch import prefetch
+from euler_tpu.parallel.prefetch import pipeline, prefetch
 
 __all__ = [
     "batch_sharding",
@@ -30,4 +30,5 @@ __all__ = [
     "state_sharding",
     "table_sharding",
     "prefetch",
+    "pipeline",
 ]

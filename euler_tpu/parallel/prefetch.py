@@ -222,3 +222,138 @@ def prefetch(
                     dropped += 1
             if dropped:
                 prof[2]("prefetch_dropped", dropped)
+
+
+def pipeline(
+    start_fn: Callable[[int], object],
+    finish_fn: Callable[[int, object], dict],
+    num_steps: int,
+    depth: int = 2,
+    start: int = 0,
+    worker_init: Callable[[int], None] | None = None,
+    profile: bool | None = None,
+    record_sample: bool = True,
+) -> Iterator[dict]:
+    """Depth-N in-flight step ring over a SPLIT sampler (train.py
+    ``sampler_depth=``): yield num_steps batches for steps
+    start..start+num_steps, kept ``depth`` submits ahead of consumption.
+
+    Where :func:`prefetch` overlaps steps by running whole ``make_batch``
+    calls on Python worker threads, this overlaps them at the native
+    layer: ``start_fn(step)`` SUBMITS the step's sampling without
+    blocking (remote graphs: one eg_remote_sample_async op whose hop
+    chain runs on the client's dispatcher pool — no Python thread holds
+    the step open) and returns a pending token; ``finish_fn(step,
+    pending)`` blocks on that token and assembles the batch. One driver
+    thread keeps up to ``depth`` steps submitted, finishes them strictly
+    in order, and lands results in the same bounded queue / phase
+    instrumentation contract as prefetch — the consumer loop, the
+    ``input_stall`` histogram, the ``eg_prefetch_*`` gauges (queue depth
+    + in-flight submits), and the produced/dropped/worker-error counters
+    all read identically, so train()'s consumer side is unchanged.
+
+    Exceptions from either fn surface at the consumer's matching step,
+    like prefetch; pending tokens submitted after a failure are dropped
+    (their native slots recycle via the handle finalizer).
+    """
+    from collections import deque
+
+    prof = _profiler() if profile in (None, True) else None
+    depth = max(1, int(depth))
+    if start:
+        base_start, base_finish = start_fn, finish_fn
+        start_fn = lambda step: base_start(step + start)  # noqa: E731
+        finish_fn = (  # noqa: E731
+            lambda step, pending: base_finish(step + start, pending)
+        )
+    # bounded: in-flight native submits are capped by the ring, finished
+    # batches by the queue — the driver blocks on put when the consumer
+    # falls behind, so at most depth submitted + depth+1 finished exist
+    out: "queue.Queue" = queue.Queue(maxsize=depth + 1)
+    stop = threading.Event()
+    busy = [0]  # steps currently submitted but not yet finished
+
+    def put(step, item) -> bool:
+        while not stop.is_set():
+            try:
+                out.put((step, item), timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def driver():
+        try:
+            if worker_init is not None:
+                worker_init(0)
+        except Exception as e:
+            if prof is not None:
+                prof[2]("prefetch_worker_errors")
+            put(0, e)
+            return
+        inflight: "deque[tuple[int, object]]" = deque()
+        step = 0
+        cur = 0
+        try:
+            while not stop.is_set() and (inflight or step < num_steps):
+                while step < num_steps and len(inflight) < depth:
+                    inflight.append((step, start_fn(step)))
+                    step += 1
+                    busy[0] = len(inflight)
+                cur, pending = inflight.popleft()
+                t0 = time.perf_counter()
+                batch = finish_fn(cur, pending)
+                busy[0] = len(inflight)
+                if prof is not None:
+                    if record_sample:
+                        prof[0](
+                            "sample", (time.perf_counter() - t0) * 1e6,
+                            step=cur + start,
+                        )
+                    prof[2]("prefetch_produced")
+                if not put(cur, batch):
+                    return
+        except Exception as e:
+            if prof is not None:
+                prof[2]("prefetch_worker_errors")
+                try:
+                    from euler_tpu.telemetry import record_span
+
+                    record_span(0, outcome=1)
+                except Exception:
+                    pass
+            # tokens still in the ring are abandoned; their handles'
+            # finalizers recycle the native slots
+            put(cur if cur >= 0 else 0, e)
+
+    t = threading.Thread(target=driver, daemon=True)
+    t.start()
+    try:
+        for want in range(num_steps):
+            t_wait = time.perf_counter()
+            _, item = out.get()  # driver produces strictly in order
+            if prof is not None:
+                record, gauges, _ = prof
+                record(
+                    "input_stall",
+                    (time.perf_counter() - t_wait) * 1e6,
+                    step=want + start,
+                )
+                gauges(out.qsize(), busy[0])
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        t.join(timeout=1.0)
+        if prof is not None:
+            dropped = 0
+            while True:
+                try:
+                    _, item = out.get_nowait()
+                except queue.Empty:
+                    break
+                if not isinstance(item, Exception):
+                    dropped += 1
+            if dropped:
+                prof[2]("prefetch_dropped", dropped)

@@ -238,6 +238,13 @@ def define_flags(parser: Optional[argparse.ArgumentParser] = None):
         "ui.perfetto.dev (OBSERVABILITY.md 'Step phases')"))
     p.add_argument("--prefetch_depth", type=int, default=2)
     p.add_argument("--prefetch_threads", type=int, default=2)
+    p.add_argument("--sampler_depth", type=int, default=2, help=(
+        "remote graphs: number of training steps whose sampling is kept "
+        "in flight through the engine's async completion queue "
+        "(eg_remote_sample_async) — step k+1..k+N fan-outs overlap step "
+        "k's H2D+device compute with no dedicated sampler threads. 0 "
+        "falls back to the thread-pool prefetch; ignored for local "
+        "graphs (PERF.md 'Pipelined sampling')"))
     p.add_argument("--profile_dir", default="")
     p.add_argument("--devprof", type=_str2bool, default=True, help=(
         "device-plane observability kill-switch (eg_devprof): XLA "
@@ -762,6 +769,7 @@ def run_train(model, graph, args, mesh):
             seed=args.seed,
             prefetch_depth=args.prefetch_depth,
             prefetch_threads=args.prefetch_threads,
+            sampler_depth=args.sampler_depth,
             checkpoint_dir=args.model_dir or None,
             profile_dir=args.profile_dir or None,
             step_hook=step_hook,

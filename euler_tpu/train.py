@@ -22,6 +22,7 @@ from euler_tpu.parallel import (
     batch_sharding,
     make_mesh,
     pad_tables_for_mesh,
+    pipeline,
     prefetch,
     put_global,
     replicated_sharding,
@@ -79,6 +80,7 @@ def train(
     seed: int = 42,
     prefetch_depth: int = 2,
     prefetch_threads: int = 2,
+    sampler_depth: int = 2,
     state: Optional[dict] = None,
     log_fn=None,
     checkpoint_dir: Optional[str] = None,
@@ -108,6 +110,19 @@ def train(
 
     source_fn(step) -> int64 root-node batch (fixed size, divisible by the
     mesh size). All sampling runs in the prefetch workers.
+
+    sampler_depth enables the native async pipeline on REMOTE graphs:
+    instead of prefetch worker threads each blocking inside a full
+    model.sample(), one driver thread keeps up to sampler_depth steps
+    submitted through the engine's completion queue
+    (model.sample_start -> eg_remote_sample_async; the hop chain runs as
+    continuations on the client dispatcher pool) and finishes them in
+    order (model.sample_finish). Step k+1..k+sampler_depth sampling
+    overlaps step k's H2D + device compute with zero dedicated sampling
+    threads, which is what drives input_stall_ms to ~0 (ROADMAP item 1,
+    PERF.md "Pipelined sampling"). sampler_depth=0 disables the split
+    and always uses the thread-pool prefetch; local in-process graphs
+    ignore it (no wire to overlap — they stay on prefetch).
 
     Multi-process (jax.distributed initialized, process_count > 1):
     source_fn yields this process's LOCAL batch (global batch /
@@ -227,6 +242,37 @@ def train(
             )
         return batch
 
+    # Native async pipeline (remote graphs only): start_batch submits the
+    # step's whole fan-out into the engine's completion queue and returns
+    # immediately; finish_batch blocks on the handle and assembles the
+    # batch. The split rides the same phase-recording contract as
+    # make_batch — "sample" here is the time spent WAITING on the handle,
+    # so a fully-hidden pipeline reads as sample ~ 0 in the phase table.
+    use_pipeline = (
+        sampler_depth > 0 and getattr(graph, "mode", None) == "remote"
+    )
+
+    def start_batch(step):
+        return model.sample_start(graph, source_fn(step))
+
+    def finish_batch(step, pending):
+        t0 = time.perf_counter()
+        batch = model.sample_finish(graph, pending)
+        if not phase_profile:
+            if device_prefetch:
+                batch = shard_batch(batch, mesh)
+                devprof.count_h2d(batch)
+            return batch
+        t1 = time.perf_counter()
+        record_phase("sample", (t1 - t0) * 1e6, step=step)
+        if device_prefetch:
+            batch = shard_batch(batch, mesh)
+            devprof.count_h2d(batch)
+            record_phase(
+                "h2d", (time.perf_counter() - t1) * 1e6, step=step
+            )
+        return batch
+
     name = model.metric_name
     history = []
     t0 = time.time()
@@ -271,16 +317,29 @@ def train(
 
     profiling = False
     t_step = time.perf_counter()
-    for batch in prefetch(
-        make_batch,
-        num_steps - start_step,
-        prefetch_depth,
-        prefetch_threads,
-        start=start_step,
-        worker_init=seed_worker,
-        profile=phase_profile,
-        record_sample=False,  # make_batch above records sample/h2d
-    ):
+    if use_pipeline:
+        batches = pipeline(
+            start_batch,
+            finish_batch,
+            num_steps - start_step,
+            depth=sampler_depth,
+            start=start_step,
+            worker_init=seed_worker,
+            profile=phase_profile,
+            record_sample=False,  # finish_batch above records sample/h2d
+        )
+    else:
+        batches = prefetch(
+            make_batch,
+            num_steps - start_step,
+            prefetch_depth,
+            prefetch_threads,
+            start=start_step,
+            worker_init=seed_worker,
+            profile=phase_profile,
+            record_sample=False,  # make_batch above records sample/h2d
+        )
+    for batch in batches:
         # phase brackets (input_stall was recorded inside prefetch):
         # h2d -> device (fenced) -> host tail; `step` spans body end to
         # body end so the sum check includes the inter-step stall

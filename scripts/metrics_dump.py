@@ -211,6 +211,19 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
             if not raw and (h2d or d2h) and dt > 0:
                 line += (f" h2d {h2d / dt / 1e6:.1f}MB/s "
                          f"d2h {d2h / dt / 1e6:.1f}MB/s")
+            # input-stall rate: ROADMAP item 1's acceptance metric as a
+            # live column — a shared-mode training process exposes its
+            # phase hists in the same scrape; mean stall per step over
+            # THIS interval, so a pipeline losing the race shows up as
+            # the number moving, not as a diluted lifetime mean
+            ih = data.get("hist", {}).get("phase:input_stall")
+            if not raw and ih and ih.get("count"):
+                lst = last.get("stall", {"count": 0, "sum_us": 0})
+                dc = ih["count"] - lst["count"]
+                ds = ih["sum_us"] - lst["sum_us"]
+                if dc > 0:
+                    line += (f" input_stall {ds / dc / 1000:.2f}ms/step"
+                             f"{_rate(dc, dt)}")
             if raw:
                 if ctr:
                     line += f"  counters {ctr}"
@@ -221,7 +234,13 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
                 }
                 line += f"  Δcounters/s {rates}"
             print(line, file=out)
-            prev[s] = {"served": served, "ctr": ctr, "t": now}
+            prev[s] = {
+                "served": served, "ctr": ctr, "t": now,
+                "stall": {
+                    "count": ih["count"] if ih else 0,
+                    "sum_us": ih["sum_us"] if ih else 0,
+                },
+            }
         out.flush()
         n += 1
 
@@ -293,11 +312,16 @@ def run_smoke() -> int:
             # fall back to cumulative counter values
             import io
 
+            # in-process shards share the client's phase globals, so a
+            # recorded stall must surface as the input_stall column
+            # (the live view of the sampler_depth pipeline's race)
+            T.record_phase("input_stall", 1500)
             buf = io.StringIO()
             watch_cluster(g, 0.05, iterations=2, out=buf)
             watch_out = buf.getvalue()
             assert "served +" in watch_out, watch_out
             assert "/s)" in watch_out, watch_out
+            assert "input_stall 1.50ms/step" in watch_out, watch_out
             buf_raw = io.StringIO()
             watch_cluster(g, 0.05, iterations=1, out=buf_raw, raw=True)
             raw_out = buf_raw.getvalue()

@@ -199,3 +199,109 @@ def test_chaos_soak_trains_through_faults_and_shard_restart(tmp_path):
                 p.kill()
         for p in procs.values():
             p.wait()
+
+
+def test_chaos_soak_async_pipeline_survives_shard_restart(tmp_path):
+    """The sampler_depth=2 soak: the same SIGKILL + restart chaos, but
+    every step's fan-out runs through the async completion queue with
+    two steps in flight (model.sample_start / sample_finish — the split
+    train.py uses for sampler_depth=2). The kill lands while a
+    continuation chain is mid-flight, so this pins the property the sync
+    soak can't reach: a shard dying BETWEEN hops of an already-submitted
+    op degrades that op like the sync path and never wedges take()."""
+    from collections import deque
+
+    import jax
+
+    from euler_tpu import train as train_lib
+    from euler_tpu.models import SupervisedGraphSage
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=NUM_PARTITIONS)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+
+    model = SupervisedGraphSage(
+        label_idx=2, label_dim=3, metapath=[[0, 1], [0, 1]],
+        fanouts=[3, 2], dim=8, feature_idx=0, feature_dim=2, max_id=16,
+    )
+    opt = train_lib.get_optimizer("adam", 0.05)
+    step = jax.jit(model.make_train_step(opt), donate_argnums=(0,))
+    roots = np.array(sorted(TOPOLOGY), dtype=np.int64)
+    DEPTH = 2
+
+    procs = {}
+    try:
+        for s in range(NUM_SHARDS):
+            procs[s] = _launch_shard(s, data, reg)
+        for s in range(NUM_SHARDS):
+            _wait_registered(s, reg)
+
+        import euler_tpu
+
+        native.counters_reset()
+        # neighbor cache OFF: the fixture is tiny enough that the
+        # init_state warm-up would cache every hop's lists and let all
+        # async slices finish inline — wire-bound continuations are the
+        # machinery under test, so force every hop onto the wire
+        g = euler_tpu.Graph(
+            mode="remote", registry=reg, retries=8, timeout_ms=2000,
+            backoff_ms=2, rediscover_ms=300, neighbor_cache_mb=0,
+            fault=FAULT_SPEC, fault_seed=FAULT_SEED,
+        )
+
+        def chaos(i):
+            if i == KILL_STEP:
+                procs[1].send_signal(signal.SIGKILL)
+                procs[1].wait()
+            if i == RESTART_STEP:
+                procs[1] = _launch_shard(1, data, reg)
+                _wait_registered(1, reg)
+                probe = np.array([13], dtype=np.int64)
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    if int(g.node_types(probe)[0]) == 1:
+                        return
+                    time.sleep(0.2)
+                raise TimeoutError("restarted shard never rejoined")
+
+        native.lib().eg_seed(1234)
+        state = model.init_state(jax.random.PRNGKey(0), g, roots, opt)
+        losses = []
+        inflight = deque()
+        submitted = 0
+        # depth-2 ring: chaos fires at SUBMIT time, so the kill hits
+        # while the previous step's continuation chain is still running
+        while len(losses) < STEPS:
+            while submitted < STEPS and len(inflight) < DEPTH:
+                chaos(submitted)
+                inflight.append(model.sample_start(g, roots))
+                submitted += 1
+            batch = model.sample_finish(g, inflight.popleft())
+            state, loss, _ = step(state, batch)
+            losses.append(float(loss))
+        counters = native.counters()
+        injected = native.fault_injected()
+        g.close()
+
+        # completed through the chaos: every loss finite, net training
+        assert all(np.isfinite(x) for x in losses)
+        assert float(np.mean(losses[-5:])) < losses[0], losses
+        # the steps really went through the completion queue
+        assert counters["async_submits"] >= STEPS, counters
+        assert counters["async_inflight_peak"] >= 1, counters
+        # with the cache off every step's hop-0 slice is wire-bound,
+        # so each submit re-enqueues at least one continuation
+        assert counters["async_continuations"] >= STEPS, counters
+        # chaos demonstrably fired and was absorbed by the same
+        # retry/failover machinery as the sync soak
+        assert injected["dial"] > 0 or injected["recv_frame"] > 0, injected
+        assert counters["retries"] + counters["calls_failed"] >= 1, counters
+    finally:
+        native.fault_clear()
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            p.wait()

@@ -273,6 +273,26 @@ def test_lock_clean_when_guard_held(tree):
     assert vs == [], "\n".join(v.message for v in vs)
 
 
+def test_lock_fires_on_unguarded_async_slot_state(tree):
+    # the async completion queue's slot state is the handoff point
+    # between dispatcher completion threads and the Python driver —
+    # a new reader skipping async_mu_ is exactly the race the TSAN
+    # round was run to exclude (SANITIZERS.md). The pass scopes field
+    # uses to the annotating stem, so the probe lands in eg_async.h
+    with open(os.path.join(tree, NATIVE_REL, "eg_async.h"), "a") as f:
+        f.write(
+            "\nnamespace eg {\n"
+            "int AsyncDriftProbe(AsyncSampleOp* op) {\n"
+            "  return op->state == AsyncSampleOp::kDone ? 1 : 0;\n"
+            "}\n"
+            "}  // namespace eg\n"
+        )
+    vs = run_pass(tree, "lock")
+    assert any(
+        v.rule == "guarded-by" and "`state`" in v.message for v in vs
+    )
+
+
 def test_lock_fires_on_unlocked_requires_call(tree):
     # calling an EG_REQUIRES(mu) helper without holding mu
     with open(os.path.join(tree, NATIVE_REL, "eg_heat.cc"), "a") as f:

@@ -51,6 +51,10 @@ COUNTER_NAMES = {
     # compile-storm guard, and host<->device transfer bytes
     "device_compiles", "device_recompiles", "serve_recompiles",
     "h2d_bytes", "d2h_bytes",
+    # async-sampler ledger (PR 18): completion-queue submissions, the
+    # high-water mark of concurrently running ops, and hop/slice
+    # continuations re-enqueued by job completions
+    "async_submits", "async_inflight_peak", "async_continuations",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
@@ -499,3 +503,112 @@ def test_same_seed_replays_identical_failure_sequence(shard):
     assert a1 == a2, "same seed must replay the same injected failures"
     assert a1 != b, "a different seed must explore a different sequence"
     assert any(a1) and not all(a1), "p=0.5 must mix successes and failures"
+
+
+# ---------------------------------------------------------------------------
+# async whole-step sampling (eg_remote_sample_async): a shard fault that
+# lands mid-continuation must degrade exactly like the sync path — same
+# counter arithmetic, same strict= contract — and the handle must still
+# complete (a faulted op that never reaches kDone would wedge take())
+# ---------------------------------------------------------------------------
+
+
+METAPATH = [[0, 1], [0, 1]]
+FANOUTS = [3, 2]
+
+
+def test_async_fault_degrades_exactly_like_sync(shard):
+    """Total send blackout during a 2-hop fan-out: the sync call and the
+    async op run the SAME NbrPrep/chunk/Finish phases, so under an
+    identical fault seed they must produce the identical degraded result
+    and the identical op-level failure ledger."""
+    svc, reg = shard
+    ids = np.array([10, 12, 14, 16], dtype=np.int64)
+
+    def run(async_mode):
+        # fresh client per run: both start from an un-quarantined pool
+        # and a cold neighbor cache, so the fault stream sees the same
+        # call sequence (cache off => every hop goes to the wire)
+        g = Graph(mode="remote", registry=reg, retries=0, timeout_ms=2000,
+                  backoff_ms=1, neighbor_cache_mb=0)
+        try:
+            g.sample_fanout(ids, METAPATH, FANOUTS)  # warm connections
+            native.fault_config("send_frame:err@1.0", 31)
+            native.counters_reset()
+            if async_mode:
+                h = g.sample_fanout_async(ids, METAPATH, FANOUTS)
+                assert h is not None, "async submit refused"
+                out = h.take()
+            else:
+                out = g.sample_fanout(ids, METAPATH, FANOUTS)
+            ctr = native.counters()
+            native.fault_clear()
+            return out, ctr
+        finally:
+            native.fault_clear()
+            g.close()
+
+    (s_ids, s_w, s_t), s_ctr = run(async_mode=False)
+    (a_ids, a_w, a_t), a_ctr = run(async_mode=True)
+    # identical degraded output (default-filled rows included)
+    for a, b in zip(s_ids, a_ids):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(s_w, a_w):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # identical op-level failure arithmetic: every chunk failed in both
+    assert s_ctr["rpc_errors"] >= 1
+    assert a_ctr["rpc_errors"] == s_ctr["rpc_errors"], (s_ctr, a_ctr)
+    assert a_ctr["calls_failed"] == s_ctr["calls_failed"], (s_ctr, a_ctr)
+    # and the async ledger accounted for the op
+    assert a_ctr["async_submits"] == 1
+    assert s_ctr["async_submits"] == 0
+
+
+def test_async_strict_raises_at_take_and_recovers(shard):
+    """strict=1: a shard failure inside an async op must surface as the
+    same RuntimeError the sync path raises — deferred to take(), the
+    first point the caller touches the result — and the pending error is
+    consumed so the next healthy call proceeds."""
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=0, timeout_ms=2000,
+              backoff_ms=1, neighbor_cache_mb=0, strict=True)
+    try:
+        ids = np.array([10, 12], dtype=np.int64)
+        g.sample_fanout(ids, METAPATH, FANOUTS)  # healthy: strict silent
+        native.fault_config("send_frame:err@1.0", 33)
+        h = g.sample_fanout_async(ids, METAPATH, FANOUTS)
+        assert h is not None
+        with pytest.raises(RuntimeError, match="shard"):
+            h.take()
+        native.fault_clear()
+        # error consumed: a following healthy async op succeeds
+        h2 = g.sample_fanout_async(ids, METAPATH, FANOUTS)
+        out_ids, _, _ = h2.take()
+        assert [len(x) for x in out_ids] == [2, 6, 12]
+    finally:
+        native.fault_clear()
+        g.close()
+
+
+def test_async_handle_completes_under_delay_fault(shard):
+    """A delay fault stretches the continuation chain without failing
+    it: poll() reports running, take() blocks until done, and the
+    result is correct — the op is slow, not wrong."""
+    svc, reg = shard
+    g = Graph(mode="remote", registry=reg, retries=1, timeout_ms=2000,
+              backoff_ms=1, neighbor_cache_mb=0)
+    try:
+        ids = np.array([10, 12], dtype=np.int64)
+        g.sample_fanout(ids, METAPATH, FANOUTS)  # warm
+        native.fault_config("send_frame:delay@60", 35)
+        native.counters_reset()
+        h = g.sample_fanout_async(ids, METAPATH, FANOUTS)
+        assert h is not None
+        out_ids, out_w, _ = h.take()
+        assert [len(x) for x in out_ids] == [2, 6, 12]
+        ctr = native.counters()
+        assert ctr["retries"] == 0  # delay is not a failure
+        assert ctr["async_continuations"] >= 1
+    finally:
+        native.fault_clear()
+        g.close()

@@ -42,14 +42,14 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 38, files  # all .cc and .h of _native
+    assert len(files) >= 39, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
     # dispatcher + feature cache), the server survivability layer
     # (bounded admission), the telemetry subsystem, the step-phase
     # profiler, the blackbox flight recorder, the data-plane heat
-    # profiler, and the locality layer (placement routing + the
-    # frequency-aware caches) must be under the gate, not
-    # grandfathered around it
+    # profiler, the locality layer (placement routing + the
+    # frequency-aware caches), and the async completion-queue sampler
+    # (eg_async) must be under the gate, not grandfathered around it
     names = {pathlib.Path(f).name for f in files}
     assert {
         "eg_fault.cc", "eg_fault.h", "eg_dispatch.cc", "eg_dispatch.h",
@@ -57,7 +57,7 @@ def test_native_tree_is_clean():
         "eg_telemetry.cc", "eg_telemetry.h", "eg_phase.cc", "eg_phase.h",
         "eg_blackbox.cc", "eg_blackbox.h", "eg_heat.cc", "eg_heat.h",
         "eg_placement.cc", "eg_placement.h",
-        "eg_devprof.cc", "eg_devprof.h",
+        "eg_devprof.cc", "eg_devprof.h", "eg_async.h",
     } <= names, names
     violations = []
     for f in files:
@@ -625,6 +625,60 @@ def test_thread_catch_fires_on_placement_refresh_shape():
     snippet = (
         "void StartRefresh() {\n"
         "  std::thread([this] { RefreshLoop(); }).detach();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
+
+
+# ---------------------------------------------------------------------------
+# async completion-queue shapes: the eg_async sampler (PR 18) stays
+# under the gate — the continuation chain runs on dispatcher threads
+# where every one of these crash classes is fatal to the whole process
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_async_submit_shape():
+    """eg_remote_sample_async is called from the train pipeline's
+    driver thread every step — a guardless entry point would carry a
+    native exception (pool full races, bad-arg asserts) straight
+    across ctypes as std::terminate."""
+    snippet = (
+        'extern "C" {\n'
+        "int eg_remote_sample_async(void* h, const uint64_t* ids, int n) {\n"
+        "  return static_cast<eg::RemoteGraph*>(h)->SampleFanoutAsync(ids, n);\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_remote_sample_async" in v.message
+
+
+def test_raw_lock_fires_on_completion_publish_shape():
+    """The kDone publish (completion thread) and the Poll/Take read
+    (driver thread) meet on async_mu_ — a raw lock there leaks the
+    mutex on any early return and wedges every in-flight op behind
+    it."""
+    snippet = (
+        "void PublishDone(AsyncSampleOp* op) {\n"
+        "  async_mu_.lock();\n"
+        "  op->state = kDone;\n"
+        "  async_mu_.unlock();\n"
+        "  async_cv_.notify_all();\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+def test_thread_catch_fires_on_async_drain_thread_shape():
+    """A dedicated completion-drain thread (a likely future extension
+    past the SubmitDetached continuation model) is a service thread
+    like any other: its entry lambda needs a top-level catch, or one
+    escaped exception takes down the trainer mid-epoch."""
+    snippet = (
+        "void StartDrain() {\n"
+        "  std::thread([this] { DrainCompletions(); }).detach();\n"
         "}\n"
     )
     (v,) = only_rule(lint(snippet), "thread-catch")
