@@ -16,6 +16,7 @@ same command surface plus sampling/walk extras:
     sn   <count> [node_type]              sample nodes
     se   <count> [edge_type]              sample edges
     walk "1, 2" "0" <len> [p] [q]         random walks
+    epoch [load <path> [shard]]           snapshot epochs / apply a delta
     help [command] / quit
 
 Usage:  python -m euler_tpu.console [--config "directory=..."]
@@ -68,6 +69,15 @@ COMMANDS = {
         "to zero everything",
         "stats [hist|phases|slow|blackbox|heat|reset]",
         "stats heat",
+    ),
+    "epoch": (
+        "Show the snapshot epoch: local graphs print the merged-delta "
+        "epoch; remote graphs print the client's last-observed epoch "
+        "per shard plus the cache generation. 'epoch load <path> "
+        "[shard]' applies a delta file (convert.py --delta-from) — "
+        "local merges in-process, remote flips the given shard live",
+        "epoch [load <path> [shard]]",
+        "epoch  |  epoch load /data/part.delta.1 0",
     ),
     "embed": (
         "Query a running embedding server (euler_tpu.serve)",
@@ -255,6 +265,31 @@ class Console:
         walks = self.graph.random_walk(nids, etypes, int(args[2]), p=p, q=q)
         for row in walks:
             print(" -> ".join(str(int(x)) for x in row))
+
+    def do_epoch(self, args: list) -> None:
+        if not self._need_graph():
+            return
+        g = self.graph
+        if args and args[0] == "load":
+            if len(args) < 2:
+                return _help(["epoch"])
+            shard = int(args[2]) if len(args) > 2 else None
+            ep = g.load_delta(args[1], shard=shard)
+            where = "local" if shard is None else f"shard {shard}"
+            print(f"applied {args[1]} -> {where} epoch {ep}")
+            return
+        if args:
+            return _help(["epoch"])
+        if g.mode == "local":
+            print(f"epoch {g.epoch()} (local; {g.epoch()} delta(s) merged)")
+            return
+        # remote: the client's passive view (v4 reply stamps + registry
+        # heartbeats), which may trail a shard that flipped but hasn't
+        # answered this client since
+        for s in range(g.num_shards):
+            print(f"shard {s}: epoch {g.shard_epoch(s)}")
+        print(f"cache_gen {g.cache_gen} (feature/neighbor/sample caches "
+              f"keyed on this; stale generations evict on next touch)")
 
     def do_embed(self, args: list) -> None:
         if len(args) < 2:

@@ -100,6 +100,7 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
       }
       opt->legacy_wire = v == 1;
       opt->v2_only = v == 2;
+      opt->v3_only = v == 3;
     } else if (key == "telemetry") {
       opt->telemetry = v != 0 ? 1 : 0;
     } else if (key == "slow_spans") {
@@ -510,6 +511,15 @@ void AdmissionServer::ServeConn(ReadyConn c) {
             kStatusBadVersion,
             "unsupported wire version " + std::to_string(env.version) +
                 " (server speaks up to 2)");
+      } else if (opt_.v3_only && env.versioned && env.version > 3) {
+        // v3-server emulation (wire_version=3 option): refuse the v4
+        // epoch envelope the way a pre-epoch build does, driving the
+        // client's progressive 4 -> 3 downgrade path
+        ctr.Add(kCtrFrameReject);
+        reply = StatusReply(
+            kStatusBadVersion,
+            "unsupported wire version " + std::to_string(env.version) +
+                " (server speaks up to 3)");
       } else if (env.versioned && env.version > kWireVersion) {
         ctr.Add(kCtrFrameReject);
         reply = StatusReply(
@@ -540,7 +550,7 @@ void AdmissionServer::ServeConn(ReadyConn c) {
         } else {
           try {
             handler_(req.data() + env.body_off, req.size() - env.body_off,
-                     &reply);
+                     env, &reply);
           } catch (const std::exception& ex) {
             // a malformed request must come back as an error reply, not
             // tear down the connection (let alone the worker)

@@ -50,6 +50,7 @@
 #include <vector>
 
 #include "eg_common.h"
+#include "eg_wire.h"
 
 namespace eg {
 
@@ -69,6 +70,9 @@ struct AdmissionOptions {
   bool v2_only = false;      // emulate a wire-v2 server (kStatusBadVersion
                              // to v3 envelopes; v2 served normally) — the
                              // trace-id downgrade drill's other direction
+  bool v3_only = false;      // emulate a wire-v3 server (kStatusBadVersion
+                             // to v4 epoch envelopes; v3 served normally) —
+                             // the epoch-stamp downgrade drill's hook
   int telemetry = -1;        // -1 = leave the process-global telemetry
                              // switch alone; 0/1 set it (eg_telemetry.h)
   int slow_spans = 0;        // >0 = slow-span journal capacity
@@ -93,9 +97,12 @@ bool ParseAdmissionOptions(const std::string& spec, AdmissionOptions* opt,
 class AdmissionServer {
  public:
   // Request handler: decode body (envelope already stripped), write the
-  // reply payload. Must not throw for ordinary malformed input (the
+  // reply payload. `env` is the parsed request envelope — the service
+  // reads the v4 pinned epoch from it and stamps ok replies with the
+  // current epoch. Must not throw for ordinary malformed input (the
   // worker adds a catch-all barrier regardless).
   using Handler = std::function<void(const char* req, size_t len,
+                                     const Envelope& env,
                                      std::string* reply)>;
 
   ~AdmissionServer() { Stop(); }

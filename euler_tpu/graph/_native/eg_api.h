@@ -28,6 +28,9 @@ class GraphAPI {
   virtual int32_t FeatureNum(int kind) const = 0;
   // kind 0 = node, 1 = edge; out sized {node,edge}_type_num.
   virtual void TypeWeightSums(int kind, float* out) const = 0;
+  // Snapshot epoch this view serves (eg_epoch.h); 0 = base load, never
+  // refreshed. Remote graphs answer the max across shards.
+  virtual uint64_t Epoch() const { return 0; }
 
   // ---- global sampling ----
   virtual void SampleNode(int count, int32_t type, uint64_t* out) const = 0;

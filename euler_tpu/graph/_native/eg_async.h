@@ -69,6 +69,15 @@ struct NbrCall {
   uint64_t nbr_hits = 0, nbr_misses = 0;
   bool heat_on = false;
   bool use_ncache = false;
+  // Snapshot-epoch capture (eg_epoch.h): `gen` keys every cache probe/
+  // fill of this call, `pin[s]` is the epoch requested from shard s in
+  // v4 envelopes — so all of a call's chunks read ONE snapshot even
+  // when a delta flip lands mid-call. NbrPrep captures both from the
+  // graph's last-observed state unless the async chain already stamped
+  // the whole op's capture (epoch_captured).
+  uint64_t gen = 0;
+  std::vector<uint64_t> pin;  // [shard] requested epoch; empty = current
+  bool epoch_captured = false;
 };
 
 // One in-flight whole-step async fan-out (RemoteGraph::SampleFanoutAsync
@@ -105,6 +114,12 @@ struct AsyncSampleOp {
   const uint64_t* cur = nullptr;
   const int32_t* et = nullptr;
   std::unique_ptr<NbrCall> call;  // current slice's staging
+  // Whole-op epoch capture (eg_epoch.h), stamped at submit and copied
+  // into every slice's NbrCall: an in-flight step keeps reading the
+  // snapshot it started on even when a shard flips between its hops
+  // (the server holds the previous epoch for exactly this reader).
+  uint64_t gen = 0;
+  std::vector<uint64_t> pin;
 
   int state EG_GUARDED_BY(async_mu_) = kFree;
 };

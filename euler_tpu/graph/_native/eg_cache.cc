@@ -74,7 +74,7 @@ uint64_t FeatureCache::Mix(uint64_t spec, uint64_t id) {
 }
 
 bool FeatureCache::Get(uint64_t spec, uint64_t id, float* out,
-                       size_t row_dim) {
+                       size_t row_dim, uint64_t gen) {
   if (cap_ == 0) return false;
   uint64_t key = Mix(spec, id);
   Stripe& st = stripes_[key % kStripes];
@@ -89,12 +89,24 @@ bool FeatureCache::Get(uint64_t spec, uint64_t id, float* out,
   if (it == st.map.end() || it->second.spec != spec || it->second.id != id ||
       it->second.row.size() != row_dim)
     return false;
+  if (it->second.gen != gen) {
+    // Pre-flip row: evict lazily right here (its fifo slot becomes a
+    // harmless dangling key the eviction walk skips) and miss — the
+    // caller refetches against the new epoch's snapshot.
+    size_t freed = it->second.row.size() * sizeof(float) + kEntryOverhead;
+    st.bytes -= freed;
+    GlobalCacheBytes().fetch_sub(static_cast<int64_t>(freed),
+                                 std::memory_order_relaxed);
+    st.map.erase(it);
+    Counters::Global().Add(kCtrEpochStaleEvict);
+    return false;
+  }
   std::memcpy(out, it->second.row.data(), row_dim * sizeof(float));
   return true;
 }
 
 void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
-                       size_t row_dim) {
+                       size_t row_dim, uint64_t gen) {
   if (cap_ == 0) return;
   size_t cost = row_dim * sizeof(float) + kEntryOverhead;
   size_t stripe_cap = cap_ / kStripes;
@@ -102,7 +114,19 @@ void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
   uint64_t key = Mix(spec, id);
   Stripe& st = stripes_[key % kStripes];
   std::lock_guard<std::mutex> l(st.mu);
-  if (st.map.count(key)) return;  // racing fetchers: first insert wins
+  auto resident = st.map.find(key);
+  if (resident != st.map.end()) {
+    // racing fetchers at the same generation: first insert wins
+    if (resident->second.gen == gen) return;
+    // pre-flip row being refreshed: evict it so the new-epoch row lands
+    size_t freed =
+        resident->second.row.size() * sizeof(float) + kEntryOverhead;
+    st.bytes -= freed;
+    GlobalCacheBytes().fetch_sub(static_cast<int64_t>(freed),
+                                 std::memory_order_relaxed);
+    st.map.erase(resident);
+    Counters::Global().Add(kCtrEpochStaleEvict);
+  }
   while (st.bytes + cost > stripe_cap && !st.fifo.empty()) {
     auto victim = st.map.find(st.fifo.front());
     if (victim != st.map.end()) {
@@ -131,6 +155,7 @@ void FeatureCache::Put(uint64_t spec, uint64_t id, const float* row,
   Entry e;
   e.spec = spec;
   e.id = id;
+  e.gen = gen;
   e.row.assign(row, row + row_dim);
   st.map.emplace(key, std::move(e));
   st.fifo.push_back(key);
@@ -190,7 +215,7 @@ uint64_t NeighborCache::Mix(uint64_t spec, uint64_t id) {
 
 bool NeighborCache::Sample(uint64_t spec, uint64_t id, int count,
                            uint64_t default_id, Rng& rng, uint64_t* out_ids,
-                           float* out_w, int32_t* out_t) {
+                           float* out_w, int32_t* out_t, uint64_t gen) {
   if (cap_ == 0) return false;
   uint64_t key = Mix(spec, id);
   Stripe& st = stripes_[key % kStripes];
@@ -198,6 +223,17 @@ bool NeighborCache::Sample(uint64_t spec, uint64_t id, int count,
   auto it = st.map.find(key);
   if (it == st.map.end() || it->second.spec != spec || it->second.id != id)
     return false;
+  if (it->second.gen != gen) {
+    // pre-flip adjacency slice: evict lazily and miss — sampling from
+    // it could draw a removed edge or miss an added one
+    size_t freed = EntryCost(it->second.ids.size());
+    st.bytes -= freed;
+    GlobalNbrCacheBytes().fetch_sub(static_cast<int64_t>(freed),
+                                    std::memory_order_relaxed);
+    st.map.erase(it);
+    Counters::Global().Add(kCtrEpochStaleEvict);
+    return false;
+  }
   const Entry& e = it->second;
   double total = e.cum.empty() ? 0.0 : e.cum.back();
   if (total <= 0.0) {
@@ -227,7 +263,8 @@ bool NeighborCache::Sample(uint64_t spec, uint64_t id, int count,
 }
 
 void NeighborCache::Put(uint64_t spec, uint64_t id, const uint64_t* nbr_ids,
-                        const float* nbr_w, const int32_t* nbr_t, size_t n) {
+                        const float* nbr_w, const int32_t* nbr_t, size_t n,
+                        uint64_t gen) {
   if (cap_ == 0) return;
   size_t cost = EntryCost(n);
   size_t stripe_cap = cap_ / kStripes;
@@ -235,7 +272,18 @@ void NeighborCache::Put(uint64_t spec, uint64_t id, const uint64_t* nbr_ids,
   uint64_t key = Mix(spec, id);
   Stripe& st = stripes_[key % kStripes];
   std::lock_guard<std::mutex> l(st.mu);
-  if (st.map.count(key)) return;  // racing fetchers: first insert wins
+  auto resident = st.map.find(key);
+  if (resident != st.map.end()) {
+    // racing fetchers at the same generation: first insert wins
+    if (resident->second.gen == gen) return;
+    // pre-flip slice being refreshed: evict so the new epoch's lands
+    size_t freed = EntryCost(resident->second.ids.size());
+    st.bytes -= freed;
+    GlobalNbrCacheBytes().fetch_sub(static_cast<int64_t>(freed),
+                                    std::memory_order_relaxed);
+    st.map.erase(resident);
+    Counters::Global().Add(kCtrEpochStaleEvict);
+  }
   while (st.bytes + cost > stripe_cap && !st.fifo.empty()) {
     auto victim = st.map.find(st.fifo.front());
     if (victim != st.map.end()) {
@@ -254,6 +302,7 @@ void NeighborCache::Put(uint64_t spec, uint64_t id, const uint64_t* nbr_ids,
   Entry e;
   e.spec = spec;
   e.id = id;
+  e.gen = gen;
   e.ids.assign(nbr_ids, nbr_ids + n);
   e.w.assign(nbr_w, nbr_w + n);
   e.t.assign(nbr_t, nbr_t + n);

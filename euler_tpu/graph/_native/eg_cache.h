@@ -1,10 +1,18 @@
 // Client-side caches for the remote graph client: dense feature rows
 // and (new) neighbor adjacency slices, both frequency-aware.
 //
-// The graph is immutable after load (the engine has no mutation API and
-// the shard services never rewrite a loaded store), so anything fetched
-// once is valid forever — no invalidation protocol, just a capacity
-// bound. On heavy-tail graphs the same hub rows are refetched endlessly
+// Each SNAPSHOT of the graph is immutable (eg_epoch.h: a delta load
+// builds a fresh snapshot and flips the serving epoch; nothing mutates
+// in place), so anything fetched once is valid for as long as the
+// client's cache GENERATION stands. Every Get/Put/Sample carries the
+// caller's current generation (RemoteGraph bumps it when any shard's
+// announced epoch moves): entries remember the generation they were
+// filled under, and a hit from an older generation is erased on the
+// spot (counted in `epoch_stale_hits_evicted`) and reported as a miss —
+// lazy invalidation, no flush sweep, no wrong-epoch row ever returned.
+// Static deployments never bump the generation and keep the original
+// fetched-once-valid-forever behavior. On heavy-tail graphs the same
+// hub rows are refetched endlessly
 // by successive batches (hubs carry most edge mass, so every fanout
 // lands on them); caching them client-side removes those rows from the
 // wire entirely.
@@ -92,11 +100,17 @@ class FeatureCache {
   // FNV-1a over the (fids, dims) request shape — the spec half of the key.
   static uint64_t SpecHash(const int32_t* fids, const int32_t* dims, int nf);
 
-  // On hit, copy row_dim floats into out and return true.
-  bool Get(uint64_t spec, uint64_t id, float* out, size_t row_dim);
-  // Insert a fetched row (no-op when disabled, already present, or
-  // rejected by frequency-aware admission — rejections counted).
-  void Put(uint64_t spec, uint64_t id, const float* row, size_t row_dim);
+  // On hit, copy row_dim floats into out and return true. `gen` is the
+  // caller's cache generation: an entry filled under an older one is
+  // evicted here (epoch_stale_hits_evicted) and the probe misses.
+  bool Get(uint64_t spec, uint64_t id, float* out, size_t row_dim,
+           uint64_t gen);
+  // Insert a fetched row tagged with the caller's generation (no-op
+  // when disabled, already present at this generation, or rejected by
+  // frequency-aware admission — rejections counted). A resident entry
+  // from an older generation is replaced, not kept.
+  void Put(uint64_t spec, uint64_t id, const float* row, size_t row_dim,
+           uint64_t gen);
 
   // Resident payload bytes (approximate: entry overhead included) —
   // observability for tests pinning the capacity bound.
@@ -106,6 +120,7 @@ class FeatureCache {
   struct Entry {
     uint64_t spec;
     uint64_t id;
+    uint64_t gen;  // cache generation the row was filled under
     std::vector<float> row;
   };
   struct Stripe {
@@ -150,15 +165,20 @@ class NeighborCache {
   // cached slice into out_* (the GraphStore::SampleNeighbors
   // distribution: weight-proportional across the union of the
   // requested edge-type groups; an empty or zero-weight slice fills
-  // default_id/-1 like the engine does) and return true.
+  // default_id/-1 like the engine does) and return true. A slice filled
+  // under an older generation than `gen` is evicted and the probe
+  // misses (epoch_stale_hits_evicted).
   bool Sample(uint64_t spec, uint64_t id, int count, uint64_t default_id,
-              Rng& rng, uint64_t* out_ids, float* out_w, int32_t* out_t);
+              Rng& rng, uint64_t* out_ids, float* out_w, int32_t* out_t,
+              uint64_t gen);
 
   // Insert one node's full adjacency slice over the spec's edge types
   // (parallel arrays, n entries; n == 0 caches the empty slice — a
-  // leaf hub's "no neighbors" answer is as cacheable as any other).
+  // leaf hub's "no neighbors" answer is as cacheable as any other),
+  // tagged with the caller's cache generation.
   void Put(uint64_t spec, uint64_t id, const uint64_t* nbr_ids,
-           const float* nbr_w, const int32_t* nbr_t, size_t n);
+           const float* nbr_w, const int32_t* nbr_t, size_t n,
+           uint64_t gen);
 
   size_t bytes() const;
 
@@ -166,6 +186,7 @@ class NeighborCache {
   struct Entry {
     uint64_t spec;
     uint64_t id;
+    uint64_t gen;  // cache generation the slice was filled under
     std::vector<uint64_t> ids;
     std::vector<float> w;
     std::vector<int32_t> t;

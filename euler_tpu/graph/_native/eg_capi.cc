@@ -15,6 +15,7 @@
 #include "eg_blackbox.h"
 #include "eg_devprof.h"
 #include "eg_engine.h"
+#include "eg_epoch.h"
 #include "eg_fault.h"
 #include "eg_heat.h"
 #include "eg_phase.h"
@@ -126,6 +127,54 @@ int eg_load_buffers(void* h, const void* const* bufs, const uint64_t* lens,
   return 0;
 }
 
+// ---- snapshot epochs (eg_epoch.h; FAULTS.md "Graph refresh") ----
+// Apply `<prefix>.delta.<n>` files to an embedded (local) graph:
+// `paths` is ';'-joined; the engine rebuilds base + all deltas into a
+// fresh immutable snapshot and adopts it in place (handle identity
+// stable, epoch advances to the delta count). Remote handles must use
+// eg_remote_load_delta — the Python layer enforces the split. -1 +
+// eg_last_error on parse/validation/merge failure (the serving snapshot
+// is untouched).
+int eg_load_deltas(void* h, const char* paths) {
+  auto* e = Local(h);
+  try {
+    std::vector<std::string> ps;
+    std::string joined = paths ? paths : "";
+    size_t pos = 0;
+    while (pos <= joined.size()) {
+      size_t semi = joined.find(';', pos);
+      if (semi == std::string::npos) semi = joined.size();
+      if (semi > pos) ps.emplace_back(joined.substr(pos, semi - pos));
+      pos = semi + 1;
+    }
+    if (ps.empty()) {
+      g_last_error = "load_deltas: no delta paths given";
+      return -1;
+    }
+    std::string err;
+    if (!eg::LoadEngineWithDeltas(e, e->source_files(), ps, &err)) {
+      // same ledger entry as Service::LoadDelta refusals: the operator
+      // watches ONE counter for refused deltas on any leg (FAULTS.md)
+      eg::Counters::Global().Add(eg::kCtrDeltaLoadFail);
+      g_last_error = err;
+      return -1;
+    }
+    return 0;
+  }
+  EG_API_GUARD(-1)
+}
+
+// Serving epoch of the handle: a local engine reports the epoch its
+// current snapshot was built at (0 = base load, N = after N deltas); a
+// remote graph reports the max epoch announced by any shard so far
+// (passively learned from v4 reply stamps and registry heartbeats).
+uint64_t eg_graph_epoch(void* h) {
+  try {
+    return API(h)->Epoch();
+  }
+  EG_API_GUARD(0)
+}
+
 void eg_seed(uint64_t seed) {
   try {
     eg::SeedThreadRng(seed);
@@ -209,6 +258,42 @@ int eg_remote_strict_error(void* h, char* buf, int cap) {
       buf[m] = '\0';
     }
     return 1;
+  }
+  EG_API_GUARD(-1)
+}
+
+// Last epoch announced by one shard (0 = never flipped or unknown) —
+// the per-shard view behind eg_graph_epoch's max, for the drill script
+// and metrics_dump's per-shard epoch column.
+uint64_t eg_remote_epoch(void* h, int shard) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->ShardEpoch(shard);
+  }
+  EG_API_GUARD(0)
+}
+// The client's cache generation: bumped once per observed epoch raise
+// on any shard. Python-side caches (serving/microbatch.py) key their
+// entries by this exactly like the native feature/neighbor caches.
+uint64_t eg_remote_cache_gen(void* h) {
+  try {
+    return static_cast<RemoteGraph*>(API(h))->cache_gen();
+  }
+  EG_API_GUARD(0)
+}
+// Ask shard `shard` to merge delta file `path` (a path on the SHARD's
+// filesystem) and flip its serving epoch (kLoadDelta). Returns the new
+// epoch (>= 1), or -1 with the shard's own error message in
+// eg_last_error (the shard keeps serving its old snapshot on failure).
+int64_t eg_remote_load_delta(void* h, int shard, const char* path) {
+  try {
+    uint64_t ep = 0;
+    std::string err;
+    if (!static_cast<RemoteGraph*>(API(h))->LoadDelta(
+            shard, path ? path : "", &ep, &err)) {
+      g_last_error = err.empty() ? "load_delta failed" : err;
+      return -1;
+    }
+    return static_cast<int64_t>(ep);
   }
   EG_API_GUARD(-1)
 }
@@ -298,6 +383,31 @@ void eg_service_drain(void* s, int grace_ms) {
     static_cast<Service*>(s)->Drain(grace_ms > 0 ? grace_ms : -1);
   }
   EG_API_GUARD()
+}
+
+// In-process delta load + epoch flip (the embedded-service twin of the
+// kLoadDelta wire op; service.py --load_delta and the drill script use
+// the wire path). Returns the new epoch, -1 + eg_last_error on failure.
+int64_t eg_service_load_delta(void* s, const char* path) {
+  try {
+    uint64_t ep = 0;
+    std::string err;
+    if (!static_cast<Service*>(s)->LoadDelta(path ? path : "", &ep,
+                                             &err)) {
+      g_last_error = err.empty() ? "load_delta failed" : err;
+      return -1;
+    }
+    return static_cast<int64_t>(ep);
+  }
+  EG_API_GUARD(-1)
+}
+
+// Current serving epoch of an in-process service (0 until first flip).
+uint64_t eg_service_epoch(void* s) {
+  try {
+    return static_cast<Service*>(s)->epoch();
+  }
+  EG_API_GUARD(0)
 }
 
 void eg_service_stop(void* s) {

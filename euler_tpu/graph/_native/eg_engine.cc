@@ -102,6 +102,7 @@ bool Engine::ParseStagings(
 
 bool Engine::LoadFiles(std::vector<std::string> files) {
   std::sort(files.begin(), files.end());
+  source_files_ = files;  // what a later delta merge rebuilds from
   // Bytes live only inside one worker iteration — only ~nthreads raw
   // files are in memory at once (the property the streamed path trades
   // away; see remote_fs.read_directory's RAM note).

@@ -48,9 +48,38 @@ class Engine : public GraphAPI {
   // byte-identical to LoadFiles on the same partitions.
   bool LoadBuffers(const char* const* bufs, const size_t* lens,
                    const char* const* names, int n);
+  // Build directly from pre-parsed stagings — the snapshot-epoch merge
+  // path (eg_epoch.cc) orders and filters stagings itself before the
+  // store build.
+  bool BuildFromStagings(std::vector<Staging>* parts) {
+    return store_.Build(parts, &error_);
+  }
   const std::string& error() const { return error_; }
 
   const GraphStore& store() const { return store_; }
+
+  // ---- snapshot epochs (eg_epoch.h) ----
+  // Which refresh generation this store represents: 0 for a plain base
+  // load, the applied-delta count for a merged load.
+  uint64_t Epoch() const override { return epoch_; }
+  void set_epoch(uint64_t e) { epoch_ = e; }
+  // The base partition files this store was built from — what a delta
+  // flip re-merges. Empty for buffer-streamed loads (those cannot
+  // delta-flip; the remote tier serves that case).
+  const std::vector<std::string>& source_files() const {
+    return source_files_;
+  }
+  void set_source_files(std::vector<std::string> files) {
+    source_files_ = std::move(files);
+  }
+  // Move another engine's built store into this one (the in-place merge
+  // path, eg_epoch.cc LoadEngineWithDeltas) — the handle identity the
+  // C ABI handed out stays stable.
+  void Adopt(Engine&& other) {
+    store_ = std::move(other.store_);
+    epoch_ = other.epoch_;
+    source_files_ = std::move(other.source_files_);
+  }
 
   // ---- introspection (GraphAPI) ----
   int64_t NumNodes() const override {
@@ -157,6 +186,8 @@ class Engine : public GraphAPI {
 
   GraphStore store_;
   std::string error_;
+  uint64_t epoch_ = 0;
+  std::vector<std::string> source_files_;
 };
 
 }  // namespace eg

@@ -55,6 +55,15 @@ enum FaultId : int {
   // The `crashes` counter is bumped BEFORE the raise, so the signal
   // handler's postmortem ledger accounts for the fire that killed it.
   kFaultCrash,
+  // Snapshot-epoch failpoints (eg_epoch.h / eg_service.cc LoadDelta):
+  kFaultDeltaLoad,     // delta file read/parse forced to fail (err) or
+                       // slowed (delay — widens the pre-flip window the
+                       // chaos soak races SIGKILL into)
+  kFaultEpochFlip,     // the flip publish itself: err refuses the flip
+                       // after the merged engine was built (the shard
+                       // keeps serving its current epoch; counted in
+                       // delta_loads_failed), delay stalls between
+                       // build and publish
   kFaultIdCount,
 };
 
@@ -63,7 +72,7 @@ const char* const kFaultNames[kFaultIdCount] = {
     "dial",           "send_frame", "recv_frame",
     "service_reply",  "registry_reply", "heartbeat",
     "accept",         "handler_stall",  "busy_force",
-    "crash",
+    "crash",          "delta_load",     "epoch_flip",
 };
 
 class FaultInjector {

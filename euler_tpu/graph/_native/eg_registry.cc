@@ -7,6 +7,7 @@
 
 #include <cctype>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <sstream>
 
@@ -145,9 +146,19 @@ std::string RegistryServer::Dispatch(const std::string& req) {
     ss >> shard >> addr;
     if (shard < 0 || shard > (1 << 20) || !ValidAddr(addr))
       return "ERR bad request";
+    // optional trailing epoch token (eg_epoch.h); absent (a pre-epoch
+    // registrant) or malformed reads as 0
+    uint64_t epoch = 0;
+    std::string tok;
+    if (op == "REG" && ss >> tok) {
+      char* end = nullptr;
+      unsigned long long v = std::strtoull(tok.c_str(), &end, 10);
+      if (end == tok.c_str() + tok.size()) epoch = v;
+    }
     std::lock_guard<std::mutex> l(mu_);
     if (op == "REG")
-      entries_[{shard, addr}] = now + std::chrono::milliseconds(ttl_ms_);
+      entries_[{shard, addr}] = {now + std::chrono::milliseconds(ttl_ms_),
+                                 epoch};
     else
       entries_.erase({shard, addr});
     // reply carries the TTL so registrants can pace heartbeats to it
@@ -157,10 +168,11 @@ std::string RegistryServer::Dispatch(const std::string& req) {
     std::ostringstream out;
     std::lock_guard<std::mutex> l(mu_);
     for (auto it = entries_.begin(); it != entries_.end();) {
-      if (it->second < now) {
+      if (it->second.expiry < now) {
         it = entries_.erase(it);  // expired: the ephemeral-znode analog
       } else {
-        out << it->first.first << " " << it->first.second << "\n";
+        out << it->first.first << " " << it->first.second << " "
+            << it->second.epoch << "\n";
         ++it;
       }
     }
@@ -193,8 +205,10 @@ bool RegistrySend(int fd, const std::string& line, int* ttl_ms) {
   return true;
 }
 
-bool RegistryList(const std::string& host, int port, int timeout_ms,
-                  std::map<int, std::vector<std::string>>* out) {
+bool RegistryList(
+    const std::string& host, int port, int timeout_ms,
+    std::map<int, std::vector<std::string>>* out,
+    std::map<std::pair<int, std::string>, uint64_t>* epochs) {
   int fd = DialTcp(host, port, timeout_ms);
   if (fd < 0) return false;
   std::string reply;
@@ -208,7 +222,20 @@ bool RegistryList(const std::string& host, int port, int timeout_ms,
     int shard = -1;
     std::string addr;
     ls >> shard >> addr;
-    if (shard >= 0 && !addr.empty()) (*out)[shard].push_back(addr);
+    if (shard >= 0 && !addr.empty()) {
+      (*out)[shard].push_back(addr);
+      if (epochs) {
+        // trailing epoch token; a pre-epoch registry emits none -> 0
+        uint64_t epoch = 0;
+        std::string tok;
+        if (ls >> tok) {
+          char* end = nullptr;
+          unsigned long long v = std::strtoull(tok.c_str(), &end, 10);
+          if (end == tok.c_str() + tok.size()) epoch = v;
+        }
+        (*epochs)[{shard, addr}] = epoch;
+      }
+    }
   }
   return true;
 }

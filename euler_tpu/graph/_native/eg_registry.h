@@ -13,11 +13,17 @@
 //
 // Wire format: the same [u32 len][payload] frames as the graph service
 // (eg_wire.h), with text payloads:
-//   "REG <shard> <host>:<port>"    -> "OK"
-//   "UNREG <shard> <host>:<port>"  -> "OK"
-//   "LIST"                         -> "<shard> <host>:<port>\n" per entry
+//   "REG <shard> <host>:<port> [<epoch>]"   -> "OK"
+//   "UNREG <shard> <host>:<port>"           -> "OK"
+//   "LIST"                 -> "<shard> <host>:<port> <epoch>\n" per entry
 // A connection may issue any number of requests; registrants typically hold
 // one open for heartbeats, clients dial once per LIST.
+//
+// The trailing epoch token (eg_epoch.h) is the discovery half of the
+// flip announcement: shards re-REG their current serving epoch every
+// heartbeat, clients see it in LIST. Backward compatible both ways —
+// pre-epoch registries and clients parse "<shard> <addr>" and ignore
+// the extra token; a missing token reads as epoch 0.
 #ifndef EG_REGISTRY_H_
 #define EG_REGISTRY_H_
 
@@ -63,10 +69,12 @@ class RegistryServer {
   std::atomic<bool> stopping_{false};
   std::thread accept_thread_;
   std::mutex mu_;  // guards entries_ and conn_fds_
-  // (shard, "host:port") -> expiry deadline
-  std::map<std::pair<int, std::string>,
-           std::chrono::steady_clock::time_point>
-      entries_;
+  struct Entry {
+    std::chrono::steady_clock::time_point expiry;
+    uint64_t epoch = 0;  // last announced serving epoch (eg_epoch.h)
+  };
+  // (shard, "host:port") -> soft state
+  std::map<std::pair<int, std::string>, Entry> entries_;
   std::set<int> conn_fds_;
   std::atomic<int> active_conns_{0};
   // signaled (under mu_) as each handler exits, so Stop() can wait on a
@@ -86,9 +94,13 @@ bool ParseTcpRegistry(const std::string& s, std::string* host, int* port);
 bool RegistrySend(int fd, const std::string& line, int* ttl_ms = nullptr);
 
 // Dial, LIST, parse into shard -> replica addresses. False on IO error
-// (empty registry is ok=true with empty *out).
-bool RegistryList(const std::string& host, int port, int timeout_ms,
-                  std::map<int, std::vector<std::string>>* out);
+// (empty registry is ok=true with empty *out). When epochs is non-null
+// it receives each entry's announced epoch keyed by (shard, addr) —
+// entries from pre-epoch registrants read as 0.
+bool RegistryList(
+    const std::string& host, int port, int timeout_ms,
+    std::map<int, std::vector<std::string>>* out,
+    std::map<std::pair<int, std::string>, uint64_t>* epochs = nullptr);
 
 }  // namespace eg
 

@@ -6,6 +6,7 @@
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
+#include <cstring>
 #include <fstream>
 #include <map>
 #include <sstream>
@@ -176,7 +177,7 @@ size_t ConnPool::num_replicas() const {
 
 bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
                     int timeout_ms, int quarantine_ms, int backoff_ms,
-                    int deadline_ms) const {
+                    int deadline_ms, uint64_t req_epoch) const {
   // Telemetry (eg_telemetry.h): the whole call — every retry, backoff
   // and failover included — is one client_call histogram sample and one
   // candidate slow span; the span's trace id rides the v3 envelope so
@@ -313,50 +314,61 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
         failed_before = true;
         break;  // next attempt (through the backoff above)
       }
-      // Wire v2: stamp the call's REMAINING budget into the envelope so
-      // the server can refuse work nobody will read. Replicas that
-      // negotiated down (old servers) get the raw v1 request.
+      // Wire envelope: stamp the call's REMAINING budget so the server
+      // can refuse work nobody will read; v3 adds the trace id, v4 the
+      // requested snapshot epoch (eg_epoch.h). Replicas that negotiated
+      // down (old servers) get the raw v1 request.
       int ver = forced_version_
                     ? forced_version_
                     : rep->wire_version.load(std::memory_order_relaxed);
       bool sent_envelope = ver != 1;
+      // version of the decisive exchange — the reply-stamp parse below
+      // keys on it (only v4 Ok replies carry the epoch)
+      int eff_ver = sent_envelope ? (ver ? ver : kWireVersion) : 1;
       auto exchange = [&](const std::string& payload) {
         const int64_t t_io = rec ? TelemetryNowUs() : 0;
         bool ok = SendFrame(fd, payload) && RecvFrame(fd, reply);
         if (rec) wire_us = static_cast<uint64_t>(TelemetryNowUs() - t_io);
         return ok;
       };
-      bool io_ok;
-      if (sent_envelope) {
+      auto wrap = [&](int v) {
         int64_t remaining = deadline - NowMs();
         if (remaining < 0) remaining = 0;
-        // negotiation (ver 0) probes with the full v3 trace envelope; a
-        // replica pinned at v2 keeps the deadline, drops the trace field
-        io_ok = exchange(WrapEnvelope(req, remaining,
-                                      ver == 2 ? 2 : kWireVersion, trace));
+        eff_ver = v;
+        return WrapEnvelope(req, remaining, v, v >= 3 ? trace : 0,
+                            v >= 4 ? req_epoch : 0);
+      };
+      bool io_ok;
+      if (sent_envelope) {
+        io_ok = exchange(wrap(ver ? ver : kWireVersion));
       } else {
         io_ok = exchange(req);
       }
       if (io_ok && sent_envelope && ver == 0) {
         // First exchange against this replica: learn its wire version.
         if (IsLegacyUnknownOpReply(*reply)) {
+          eff_ver = 1;
           rep->wire_version.store(1, std::memory_order_relaxed);
           ctr.Add(kCtrWireDowngrade);
           // the old server answered its stock error and kept the
           // connection healthy: resend the raw request on it
           io_ok = exchange(req);
-        } else if (!reply->empty() &&
-                   static_cast<uint8_t>((*reply)[0]) == kStatusBadVersion) {
-          // a v2-era server refused the v3 trace envelope with a clean
-          // versioned error: pin v2 (deadlines still propagate, the
-          // trace id just doesn't) and resend on the same connection
-          rep->wire_version.store(2, std::memory_order_relaxed);
-          ctr.Add(kCtrWireDowngrade);
-          int64_t remaining = deadline - NowMs();
-          if (remaining < 0) remaining = 0;
-          io_ok = exchange(WrapEnvelope(req, remaining, 2, 0));
         } else {
-          rep->wire_version.store(kWireVersion, std::memory_order_relaxed);
+          // Progressive BadVersion ladder: each refusal only says "too
+          // new", so step down ONE version per answer (4 -> 3 -> 2) and
+          // resend on the same connection until the replica accepts.
+          // The replica pins at the highest version it spoke; one
+          // wire_downgrades count per replica pinned below this build.
+          int probe = kWireVersion;
+          while (io_ok && probe > 2 && !reply->empty() &&
+                 static_cast<uint8_t>((*reply)[0]) == kStatusBadVersion) {
+            --probe;
+            io_ok = exchange(wrap(probe));
+          }
+          if (io_ok) {
+            rep->wire_version.store(probe, std::memory_order_relaxed);
+            if (probe < kWireVersion) ctr.Add(kCtrWireDowngrade);
+          }
         }
       }
       if (io_ok) {
@@ -400,6 +412,18 @@ bool ConnPool::Call(const std::string& req, std::string* reply, int retries,
           std::lock_guard<std::mutex> l(rep->mu);
           rep->idle.push_back(fd);
         }
+        // v4 Ok replies carry the shard's serving epoch right after the
+        // status byte (the passive flip announcement, eg_epoch.h):
+        // strip it so every downstream decoder sees the versionless
+        // body, then hand it to the observer (which bumps the client
+        // cache generation when the epoch moved). Error/BUSY/deadline
+        // replies are never stamped.
+        if (status == kStatusOk && eff_ver >= 4 && reply->size() >= 9) {
+          uint64_t ep;
+          std::memcpy(&ep, reply->data() + 1, sizeof(ep));
+          reply->erase(1, 8);
+          if (epoch_observer_) epoch_observer_(ep);
+        }
         return finish(true, kOutcomeOk);
       }
       ::close(fd);
@@ -442,14 +466,23 @@ bool RemoteGraph::Discover(
     // (unexpired) entries — the watch-children analog of the reference's
     // ZK monitor (zk_server_monitor.cc:50-64).
     std::map<int, std::vector<std::string>> listed;
-    if (!RegistryList(reg_host_, reg_port_, timeout_ms, &listed))
+    std::map<std::pair<int, std::string>, uint64_t> epochs;
+    if (!RegistryList(reg_host_, reg_port_, timeout_ms, &listed, &epochs))
       return false;
     for (auto& [shard, addrs] : listed) {
       for (auto& a : addrs) {
         std::string host;
         int port;
-        if (ParseHostPort(a, &host, &port))
+        if (ParseHostPort(a, &host, &port)) {
           (*shards)[shard].emplace_back(host, port);
+          // heartbeat epoch tokens are the discovery half of the flip
+          // announcement — a client that goes quiet between steps still
+          // learns a flip within one registry poll (no-op before Init
+          // allocates the epoch table)
+          auto it = epochs.find({shard, a});
+          if (it != epochs.end() && it->second)
+            ObserveEpoch(shard, it->second);
+        }
       }
     }
     return true;
@@ -660,6 +693,16 @@ bool RemoteGraph::Init(const std::string& config) {
     pools_[s].SetShard(s);
     for (auto& [host, port] : shards[s]) pools_[s].AddReplica(host, port);
   }
+  // Snapshot-epoch client state (eg_epoch.h): per-shard last-observed
+  // epoch + the cache generation. Observers installed before the kInfo
+  // fetches below, so even Init's own calls learn an already-flipped
+  // cluster's epochs.
+  shard_epoch_.reset(new std::atomic<uint64_t>[num_shards_]);
+  for (int s = 0; s < num_shards_; ++s) {
+    shard_epoch_[s].store(0, std::memory_order_relaxed);
+    pools_[s].SetEpochObserver(
+        [this, s](uint64_t e) { ObserveEpoch(s, e); });
+  }
 
   // Persistent scatter/gather pool: sized so every shard can be in
   // flight at once with headroom for chunk fan-out and multiple client
@@ -816,9 +859,9 @@ void RemoteGraph::TypeWeightSums(int kind, float* out) const {
 }
 
 bool RemoteGraph::Call(int shard, const std::string& req,
-                       std::string* reply) const {
+                       std::string* reply, uint64_t epoch) const {
   if (!pools_[shard].Call(req, reply, retries_, timeout_ms_, quarantine_ms_,
-                          backoff_ms_, deadline_ms_))
+                          backoff_ms_, deadline_ms_, epoch))
     return false;
   if (reply->empty() || (*reply)[0] != 0) {
     // transport delivered a frame, but the shard refused the request —
@@ -826,6 +869,70 @@ bool RemoteGraph::Call(int shard, const std::string& req,
     Counters::Global().Add(kCtrFrameReject);
     return false;
   }
+  return true;
+}
+
+void RemoteGraph::ObserveEpoch(int shard, uint64_t epoch) const {
+  if (!shard_epoch_ || shard < 0 || shard >= num_shards_) return;
+  uint64_t cur = shard_epoch_[shard].load(std::memory_order_relaxed);
+  // Monotonic raise: stale announcements (a reply that raced a flip, a
+  // lagging registry token) never move the epoch backwards, so the
+  // cache generation bumps exactly once per observed flip per shard.
+  while (epoch > cur) {
+    if (shard_epoch_[shard].compare_exchange_weak(
+            cur, epoch, std::memory_order_acq_rel)) {
+      cache_gen_.fetch_add(1, std::memory_order_acq_rel);
+      return;
+    }
+  }
+}
+
+uint64_t RemoteGraph::Epoch() const {
+  uint64_t mx = 0;
+  if (shard_epoch_)
+    for (int s = 0; s < num_shards_; ++s)
+      mx = std::max(mx, shard_epoch_[s].load(std::memory_order_relaxed));
+  return mx;
+}
+
+bool RemoteGraph::LoadDelta(int shard, const std::string& path,
+                            uint64_t* new_epoch, std::string* error) const {
+  if (shard < 0 || shard >= num_shards_) {
+    *error = "bad shard index " + std::to_string(shard);
+    return false;
+  }
+  WireWriter req;
+  req.U8(kLoadDelta);
+  req.Str(path);
+  std::string reply;
+  // raw pool call (not Call): an error status must surface the shard's
+  // message, not collapse into a counted frame reject
+  if (!pools_[shard].Call(req.buf(), &reply, retries_, timeout_ms_,
+                          quarantine_ms_, backoff_ms_, deadline_ms_)) {
+    *error = "shard " + std::to_string(shard) +
+             " unreachable for load_delta";
+    return false;
+  }
+  WireReader r(reply);
+  uint8_t status = r.U8();
+  if (status != kStatusOk) {
+    std::string msg = r.Str();
+    *error = r.ok() && !msg.empty()
+                 ? msg
+                 : "load_delta failed on shard " + std::to_string(shard);
+    return false;
+  }
+  // the v4 epoch stamp was already stripped (and observed — this
+  // client's caches invalidated) by ConnPool; the body is the new epoch
+  *new_epoch = r.U64();
+  if (!r.ok()) {
+    *error = "malformed load_delta reply from shard " +
+             std::to_string(shard);
+    return false;
+  }
+  // belt over suspenders for pre-stamp replicas: the reply body itself
+  // announces the flip even when the envelope negotiated below v4
+  ObserveEpoch(shard, *new_epoch);
   return true;
 }
 
@@ -1261,6 +1368,20 @@ void RemoteGraph::NbrPrep(NbrCall* c) const {
   Heat& heat = Heat::Global();
   c->heat_on = heat.enabled();
   c->use_ncache = ncache_.enabled();
+  // Snapshot-epoch capture (eg_epoch.h): unless the async chain already
+  // stamped a whole-op capture into this slice, pin the call to the
+  // generation/epochs observed NOW — every cache probe and wire chunk of
+  // this call then reads one consistent snapshot even if a flip lands
+  // mid-call.
+  if (!c->epoch_captured) {
+    c->gen = cache_gen_.load(std::memory_order_acquire);
+    if (shard_epoch_) {
+      c->pin.assign(static_cast<size_t>(num_shards_), 0);
+      for (int s = 0; s < num_shards_; ++s)
+        c->pin[s] = shard_epoch_[s].load(std::memory_order_relaxed);
+    }
+    c->epoch_captured = true;
+  }
   c->nspec = c->use_ncache ? NeighborCache::SpecHash(c->etypes, c->net) : 0;
   c->rep_off.assign(num_shards_, {});
   c->sid.assign(num_shards_, {});
@@ -1298,7 +1419,8 @@ void RemoteGraph::NbrPrep(NbrCall* c) const {
         int64_t dst = c->rep_off[s][j] * c->count;
         if (ncache_.Sample(c->nspec, sub[j], static_cast<int>(draws_j),
                            c->default_id, rng, c->sid[s].data() + dst,
-                           c->sw[s].data() + dst, c->st[s].data() + dst)) {
+                           c->sw[s].data() + dst, c->st[s].data() + dst,
+                           c->gen)) {
           c->ok[s][j] = 1;
           ++c->nbr_hits;
           continue;
@@ -1344,7 +1466,9 @@ bool RemoteGraph::NbrFetchChunk(NbrCall* c, int s, int32_t b,
   req.I32(c->count);
   req.U64(c->default_id);
   std::string reply;
-  if (!Call(s, req.buf(), &reply)) return false;
+  if (!Call(s, req.buf(), &reply,
+            c->pin.empty() ? 0 : c->pin[static_cast<size_t>(s)]))
+    return false;
   Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
   WireReader r(reply);
   r.U8();
@@ -1384,7 +1508,9 @@ bool RemoteGraph::NbrPromoteChunk(NbrCall* c, int s, int32_t b,
   req.Arr(c->etypes, c->net);
   req.U8(0);
   std::string reply;
-  if (!Call(s, req.buf(), &reply)) return false;
+  if (!Call(s, req.buf(), &reply,
+            c->pin.empty() ? 0 : c->pin[static_cast<size_t>(s)]))
+    return false;
   Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
   WireReader r(reply);
   r.U8();
@@ -1413,7 +1539,8 @@ bool RemoteGraph::NbrPromoteChunk(NbrCall* c, int s, int32_t b,
     // cache the slice for every later call (TinyLFU admission
     // may still refuse it — the draws below don't depend on
     // that verdict, the slice is in hand either way)
-    ncache_.Put(c->nspec, sub[x], nid, nw, nt, static_cast<size_t>(len));
+    ncache_.Put(c->nspec, sub[x], nid, nw, nt, static_cast<size_t>(len),
+                c->gen);
     int64_t draws_x = static_cast<int64_t>(c->plan.reps[s][pos]) * c->count;
     int64_t dst = c->rep_off[s][pos] * c->count;
     DrawFromSlice(nid, nw, nt, len, draws_x, c->default_id, rng,
@@ -1604,6 +1731,11 @@ void RemoteGraph::StartSlice(AsyncSampleOp* op) const {
     c->out_ids = op->out_ids[h] + off * op->counts[h];
     c->out_w = op->out_w[h] + off * op->counts[h];
     c->out_t = op->out_t[h] + off * op->counts[h];
+    // whole-op epoch capture: every slice of this step reads the
+    // snapshot stamped at submit, even if a shard flips between hops
+    c->gen = op->gen;
+    c->pin = op->pin;
+    c->epoch_captured = true;
     NbrPrep(c);
     std::vector<std::function<void()>> jobs;
     if (c->n > 0 && c->count > 0) NbrBuildJobs(c, &jobs);
@@ -1689,6 +1821,17 @@ int RemoteGraph::SampleFanoutAsync(const uint64_t* ids, int n,
   op.cur_n = n;
   op.cur = op.ids.data();
   op.et = op.etypes_flat.data();
+  // stamp the whole-op epoch capture once, at submit: a flip that lands
+  // while this step's continuation chain is in flight must not tear the
+  // step across snapshots (tests/test_epoch.py pins bit-parity here)
+  op.gen = cache_gen_.load(std::memory_order_acquire);
+  op.pin.clear();
+  if (shard_epoch_) {
+    op.pin.resize(static_cast<size_t>(num_shards_), 0);
+    for (int s = 0; s < num_shards_; ++s)
+      op.pin[static_cast<size_t>(s)] =
+          shard_epoch_[s].load(std::memory_order_relaxed);
+  }
   StartSlice(&op);
   return slot;
 }
@@ -2001,6 +2144,16 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
   const bool use_cache = fcache_.enabled();
   const uint64_t spec =
       use_cache ? FeatureCache::SpecHash(fids, dims, nf) : 0;
+  // one generation + epoch-pin capture for the whole gather: every probe
+  // and fill below reads a single snapshot (eg_epoch.h)
+  const uint64_t gen = cache_gen_.load(std::memory_order_acquire);
+  std::vector<uint64_t> pin;
+  if (shard_epoch_) {
+    pin.resize(static_cast<size_t>(num_shards_), 0);
+    for (int s = 0; s < num_shards_; ++s)
+      pin[static_cast<size_t>(s)] =
+          shard_epoch_[s].load(std::memory_order_relaxed);
+  }
   // Staging over unique entries; cache hits fill their rows up front and
   // drop out of the fetch lists entirely (zero wire bytes).
   std::vector<std::vector<float>> sval(num_shards_);
@@ -2032,7 +2185,7 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
       uint64_t id = ids[plan.rows[s][j]];
       if (use_cache &&
           fcache_.Get(spec, id, sval[s].data() + j * row_dim,
-                      static_cast<size_t>(row_dim))) {
+                      static_cast<size_t>(row_dim), gen)) {
         ok[s][j] = 1;
         ++hits;
         if (heat_on) ++cls_hit[cls[j]];
@@ -2059,7 +2212,9 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
     req.Arr(fids, nf);
     req.Arr(dims, nf);
     std::string reply;
-    if (!Call(s, req.buf(), &reply)) return false;
+    if (!Call(s, req.buf(), &reply,
+              pin.empty() ? 0 : pin[static_cast<size_t>(s)]))
+      return false;
     Heat::Global().AddShardBytes(s, req.buf().size(), reply.size());
     WireReader r(reply);
     r.U8();
@@ -2074,7 +2229,7 @@ void RemoteGraph::GetDenseFeature(const uint64_t* ids, int n,
       ok[s][j] = 1;
       if (use_cache)
         fcache_.Put(spec, sub[x], vals + static_cast<int64_t>(x) * row_dim,
-                    static_cast<size_t>(row_dim));
+                    static_cast<size_t>(row_dim), gen);
     }
     return true;
   });

@@ -27,7 +27,11 @@
 //     duplicate rows still receive independent draws;
 //   * dense feature rows are served from a capacity-bounded client cache
 //     (eg_cache.h, `feature_cache_mb=`, `cache_hits`/`cache_misses`) —
-//     the graph is immutable after load, so cached rows never invalidate.
+//     each SNAPSHOT is immutable, so cached rows only invalidate when a
+//     shard announces a new epoch (eg_epoch.h): every v4 Ok reply stamps
+//     the shard's serving epoch, ObserveEpoch bumps the client cache
+//     generation on change, and stale-generation entries evict lazily on
+//     their next probe (`epoch_stale_hits_evicted`).
 #ifndef EG_REMOTE_H_
 #define EG_REMOTE_H_
 
@@ -68,9 +72,11 @@ class ConnPool {
     std::string host;
     int port = 0;
     std::atomic<int64_t> bad_until_ms{0};
-    // Negotiated wire version: 0 = unknown (send the v2 envelope and
-    // learn from the first reply), 1 = downgraded to raw v1 (old
-    // server), 2 = confirmed v2. See eg_wire.h for the contract.
+    // Negotiated wire version: 0 = unknown (probe with the newest
+    // envelope and learn from the first reply), 1 = downgraded to raw
+    // v1 (pre-envelope server), 2..kWireVersion = pinned at the highest
+    // version the replica accepted (the BadVersion ladder steps down
+    // one version per refusal). See eg_wire.h for the contract.
     std::atomic<int> wire_version{0};
     std::mutex mu;
     std::vector<int> idle;  // pooled connected sockets
@@ -91,10 +97,20 @@ class ConnPool {
 
   // Pin every replica's wire version instead of negotiating: 1 emulates
   // a pre-envelope client (raw v1 requests, no deadline stamped), 2
-  // forces the deadline envelope without a trace id, 3 forces the full
-  // trace envelope. 0 (default) negotiates per replica (v3 probe; an
-  // old server's reply downgrades the replica to v1 or v2).
+  // forces the deadline envelope without a trace id, 3 the trace
+  // envelope without an epoch, 4 the full epoch envelope. 0 (default)
+  // negotiates per replica (v4 probe; an old server's reply walks the
+  // replica down the 4 -> 3 -> 2 -> 1 ladder).
   void SetForcedWireVersion(int v) { forced_version_ = v; }
+
+  // Install the flip-announcement hook (eg_epoch.h): called with the
+  // serving epoch stamped on every v4 Ok reply, after the stamp is
+  // stripped from the reply body. Runs on whatever thread completed the
+  // exchange (dispatcher workers) — the observer must be thread-safe.
+  // Set once at init, before any Call.
+  void SetEpochObserver(std::function<void(uint64_t)> fn) {
+    epoch_observer_ = std::move(fn);
+  }
 
   // One request/reply exchange; retries across replicas with exponential
   // backoff (full jitter, base backoff_ms, capped at 2 s) between
@@ -120,9 +136,16 @@ class ConnPool {
   //     (wire_downgrades).
   // Thread-safe: chunked requests Call the same pool concurrently from
   // several dispatcher workers, each exchange on its own pooled socket.
+  //
+  // `req_epoch` (eg_epoch.h) rides the v4 envelope: 0 asks for the
+  // shard's current snapshot, nonzero pins the request to that epoch
+  // when the shard still holds it (an in-flight multi-hop step keeps
+  // reading the snapshot it started on across a flip). Replicas
+  // negotiated below v4 simply drop the field — they serve their only
+  // epoch anyway.
   bool Call(const std::string& req, std::string* reply, int retries,
             int timeout_ms, int quarantine_ms, int backoff_ms = 20,
-            int deadline_ms = 0) const;
+            int deadline_ms = 0, uint64_t req_epoch = 0) const;
 
  private:
   mutable std::mutex mu_;  // guards replicas_ (the vector, not the pools)
@@ -130,6 +153,8 @@ class ConnPool {
   mutable std::atomic<size_t> rr_{0};
   int forced_version_ = 0;  // 0 = negotiate per replica
   int shard_ = -1;          // telemetry label only
+  // v4 reply-stamp hook (SetEpochObserver); empty = stamps discarded.
+  std::function<void(uint64_t)> epoch_observer_;
 };
 
 class RemoteGraph : public GraphAPI {
@@ -223,6 +248,29 @@ class RemoteGraph : public GraphAPI {
   // scripts/heat_dump.py builds its skew report from. False on
   // transport failure / bad shard index.
   bool HeatShard(int shard, std::string* json) const;
+  // ---- snapshot epochs (eg_epoch.h) ----
+  // Highest serving epoch observed across shards (v4 reply stamps +
+  // registry heartbeat tokens); 0 until any shard announces a flip.
+  uint64_t Epoch() const override;
+  // Per-shard last-observed epoch (metrics surface; 0 = none observed).
+  uint64_t ShardEpoch(int shard) const {
+    return shard_epoch_ && shard >= 0 && shard < num_shards_
+               ? shard_epoch_[shard].load(std::memory_order_relaxed)
+               : 0;
+  }
+  // Cache generation: bumped whenever any shard's observed epoch moves.
+  // Python-side sample caches key on this the same way the native
+  // feature/neighbor caches do.
+  uint64_t cache_gen() const {
+    return cache_gen_.load(std::memory_order_acquire);
+  }
+  // Ask one shard to merge a delta file and flip (kLoadDelta). The Ok
+  // reply's epoch stamp doubles as the flip announcement, so this
+  // client's caches invalidate before the call returns. False + *error
+  // on transport failure or a shard-side load/validate/merge error.
+  bool LoadDelta(int shard, const std::string& path, uint64_t* new_epoch,
+                 std::string* error) const;
+
   // True when init fetched + parsed a placement map and ids route
   // through it (false = hash routing, the compat fallback).
   bool has_placement() const { return placement_.loaded(); }
@@ -357,8 +405,14 @@ class RemoteGraph : public GraphAPI {
   void GroupByShard(const uint64_t* ids, int n,
                     std::vector<std::vector<int32_t>>* rows) const;
   // Issue req to shard; decode reply past the status byte into *reply.
-  // False on transport failure or error status.
-  bool Call(int shard, const std::string& req, std::string* reply) const;
+  // False on transport failure or error status. `epoch` pins the
+  // request to a snapshot the shard may still hold (0 = current).
+  bool Call(int shard, const std::string& req, std::string* reply,
+            uint64_t epoch = 0) const;
+  // Flip-announcement sink (ConnPool epoch observer + registry epoch
+  // tokens): raises shard_epoch_[shard] monotonically and bumps
+  // cache_gen_ on every raise.
+  void ObserveEpoch(int shard, uint64_t epoch) const;
   // Record a per-shard op failure: rpc_errors counter, plus the pending
   // strict-mode error under strict=1.
   void ShardFailed(int shard, const char* what) const;
@@ -459,6 +513,15 @@ class RemoteGraph : public GraphAPI {
   // id -> partition routing map fetched at init (empty = hash routing).
   PlacementMap placement_;
   bool placement_enabled_ = true;  // placement= config key
+  // ---- snapshot-epoch client state (eg_epoch.h) ----
+  // Last epoch each shard announced (reply stamps / registry tokens);
+  // allocated by Init once num_shards_ is known. Mutable: announcements
+  // arrive inside const query paths.
+  mutable std::unique_ptr<std::atomic<uint64_t>[]> shard_epoch_;
+  // Monotonic cache generation; every epoch raise bumps it, and all
+  // cache probes/fills (native + the Python sample cache via
+  // eg_remote_cache_gen) carry the bump's value.
+  mutable std::atomic<uint64_t> cache_gen_{0};
   mutable std::mutex strict_mu_;        // guards strict_error_
   mutable std::string strict_error_;    // first pending strict failure
   // Async op slot pool (SampleFanoutAsync). Sized for the pipeline's

@@ -74,10 +74,14 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
     error_ = "no .dat partitions in " + data_dir;
     return false;
   }
-  if (!engine_.Load(data_dir, shard_idx, shard_num)) {
-    error_ = engine_.error();
+  auto base = std::make_shared<Engine>();
+  if (!base->Load(data_dir, shard_idx, shard_num)) {
+    error_ = base->error();
     return false;
   }
+  base_files_ = base->source_files();
+  epochs_.Reset(std::move(base), 0);
+  announced_epoch_.store(0, std::memory_order_release);
   // Placement artifact (eg_placement.h): read the blob AND parse it —
   // a corrupt artifact must fail the shard start loudly, not surface
   // later as client-side misrouting against whichever shards parsed it.
@@ -101,9 +105,8 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
   }
   if (!admission_.Start(
           listen_fd, opt,
-          [this](const char* req, size_t len, std::string* reply) {
-            Dispatch(req, len, reply);
-          },
+          [this](const char* req, size_t len, const Envelope& env,
+                 std::string* reply) { Dispatch(req, len, env, reply); },
           &error_)) {
     ::close(listen_fd);
     return false;
@@ -123,18 +126,25 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
       Stop();
       return false;
     }
-    const std::string line = "REG " + std::to_string(shard_idx_) + " " +
-                             host_ + ":" + std::to_string(port_);
+    // REG lines carry a trailing epoch token ("REG <shard> <addr>
+    // <epoch>") — pre-epoch registries parse shard + addr and ignore
+    // the extra token, so the announcement is backward compatible. The
+    // line is re-composed EVERY beat from announced_epoch_, which is
+    // how a flip propagates to discovery within one TTL third.
+    const std::string line_base = "REG " + std::to_string(shard_idx_) +
+                                  " " + host_ + ":" +
+                                  std::to_string(port_);
     int ttl_ms = 10000;
     int fd = DialTcp(reg_host_, reg_port_, 2000);
-    if (fd < 0 || !RegistrySend(fd, line, &ttl_ms)) {
+    if (fd < 0 || !RegistrySend(fd, line_base + " 0", &ttl_ms)) {
       if (fd >= 0) ::close(fd);
       error_ = "cannot register with tcp registry " + registry_dir;
       Stop();
       return false;
     }
     heartbeat_stop_ = false;
-    heartbeat_thread_ = std::thread([this, line, fd, ttl_ms]() mutable {
+    heartbeat_thread_ = std::thread([this, line_base, fd,
+                                     ttl_ms]() mutable {
       try {
         while (!heartbeat_stop_.load(std::memory_order_acquire)) {
           // wake every 50 ms so Stop() stays prompt even with short TTLs
@@ -143,6 +153,10 @@ bool Service::Start(const std::string& data_dir, int shard_idx, int shard_num,
                slept += 50)
             std::this_thread::sleep_for(std::chrono::milliseconds(50));
           if (heartbeat_stop_) break;
+          const std::string line =
+              line_base + " " +
+              std::to_string(
+                  announced_epoch_.load(std::memory_order_acquire));
           // kFaultHeartbeat forces this beat to miss: the held connection
           // is dropped and the redial path below must keep the registry
           // entry alive — exactly what a blipped registry link exercises.
@@ -232,13 +246,30 @@ bool OversizedResult(int64_t elems, std::string* reply) {
 
 }  // namespace
 
-void Service::Dispatch(const char* req, size_t len,
-                       std::string* reply) const {
+void Service::Dispatch(const char* req, size_t len, const Envelope& env,
+                       std::string* reply) {
   eg::SpanTimer span(eg::kStatServiceRequest);
   WireReader r(req, len);
   uint8_t op = r.U8();
+  // Pin the epoch this request runs against: v4 requests may ask for
+  // the epoch their op started on (0 = current); anything the table no
+  // longer holds falls back to current. The pin holds the snapshot's
+  // drain back until this reply is built.
+  EpochPin pin =
+      epochs_.Pin(env.versioned && env.version >= 4 ? env.epoch : 0);
+  if (!pin) {
+    *reply = StatusReply(kStatusError, "shard has no snapshot");
+    return;
+  }
+  const Engine& eng = *pin.engine();
   WireWriter w;
   w.U8(0);  // ok status; overwritten on decode error below
+  // v4 ok replies carry the shard's CURRENT epoch right after the
+  // status byte — the passive flip announcement. Placeholder now,
+  // patched after dispatch so a kLoadDelta reply announces the epoch
+  // it just flipped to.
+  const bool stamp = env.versioned && env.version >= 4;
+  if (stamp) w.U64(0);
 
   // Server-side heat feed (eg_heat.h): the decoded id array,
   // PRE-execute, tagged by op + the requesting conn ServeConn stamped
@@ -264,6 +295,7 @@ void Service::Dispatch(const char* req, size_t len,
       g.queue_depth = admission_.queue_depth();
       g.conns = admission_.conns();
       g.draining = admission_.draining() ? 1 : 0;
+      g.epoch = static_cast<int64_t>(epochs_.current());
       w.Str(Telemetry::Global().Json(shard_idx_, &g));
       break;
     }
@@ -299,8 +331,27 @@ void Service::Dispatch(const char* req, size_t len,
       w.Str(placement_blob_);
       break;
     }
+    case kLoadDelta: {
+      // Snapshot-epoch delta load (eg_epoch.h): merge + flip, reply
+      // [u64 new_epoch]. Failure answers an error string and leaves the
+      // current epoch serving (already counted in delta_loads_failed).
+      std::string path = r.Str();
+      if (r.ok()) {
+        uint64_t new_epoch = 0;
+        std::string err;
+        if (!LoadDelta(path, &new_epoch, &err)) {
+          WireWriter e;
+          e.U8(1);
+          e.Str(err);
+          *reply = std::move(e.buf());
+          return;
+        }
+        w.U64(new_epoch);
+      }
+      break;
+    }
     case kInfo: {
-      const GraphStore& s = engine_.store();
+      const GraphStore& s = eng.store();
       w.I64(static_cast<int64_t>(s.num_nodes()));
       w.I64(static_cast<int64_t>(s.num_edges()));
       w.I32(s.node_type_num());
@@ -322,7 +373,7 @@ void Service::Dispatch(const char* req, size_t len,
       int32_t count = r.I32(), type = r.I32();
       if (OversizedResult(count, reply)) return;
       std::vector<uint64_t> out(std::max<int32_t>(count, 0));
-      if (r.ok() && count >= 0) engine_.SampleNode(count, type, out.data());
+      if (r.ok() && count >= 0) eng.SampleNode(count, type, out.data());
       w.Arr(out);
       break;
     }
@@ -333,7 +384,7 @@ void Service::Dispatch(const char* req, size_t len,
       std::vector<uint64_t> src(n), dst(n);
       std::vector<int32_t> t(n);
       if (r.ok() && count >= 0)
-        engine_.SampleEdge(count, type, src.data(), dst.data(), t.data());
+        eng.SampleEdge(count, type, src.data(), dst.data(), t.data());
       w.Arr(src);
       w.Arr(dst);
       w.Arr(t);
@@ -344,7 +395,7 @@ void Service::Dispatch(const char* req, size_t len,
       const uint64_t* ids = r.Arr<uint64_t>(&n);
       if (r.ok()) feed(ids, n);
       std::vector<int32_t> out(static_cast<size_t>(n));
-      if (r.ok()) engine_.GetNodeType(ids, static_cast<int>(n), out.data());
+      if (r.ok()) eng.GetNodeType(ids, static_cast<int>(n), out.data());
       w.Arr(out);
       break;
     }
@@ -353,7 +404,7 @@ void Service::Dispatch(const char* req, size_t len,
       const uint64_t* ids = r.Arr<uint64_t>(&n);
       if (r.ok()) feed(ids, n);
       std::vector<float> out(static_cast<size_t>(n));
-      if (r.ok()) engine_.GetNodeWeight(ids, static_cast<int>(n), out.data());
+      if (r.ok()) eng.GetNodeWeight(ids, static_cast<int>(n), out.data());
       w.Arr(out);
       break;
     }
@@ -371,7 +422,7 @@ void Service::Dispatch(const char* req, size_t len,
       std::vector<float> ow(total);
       std::vector<int32_t> ot(total);
       if (r.ok() && count >= 0)
-        engine_.SampleNeighbor(ids, static_cast<int>(n), etypes,
+        eng.SampleNeighbor(ids, static_cast<int>(n), etypes,
                                static_cast<int>(net), count, def, oid.data(),
                                ow.data(), ot.data());
       w.Arr(oid);
@@ -416,7 +467,7 @@ void Service::Dispatch(const char* req, size_t len,
       for (int64_t i = 0; i < n; ++i) {
         int64_t m = static_cast<int64_t>(reps[i]) * count;
         if (m > 0)
-          engine_.SampleNeighbor(ids + i, 1, etypes, static_cast<int>(net),
+          eng.SampleNeighbor(ids + i, 1, etypes, static_cast<int>(net),
                                  static_cast<int>(m), def, oid.data() + off,
                                  ow.data() + off, ot.data() + off);
         off += m;
@@ -433,7 +484,7 @@ void Service::Dispatch(const char* req, size_t len,
       uint8_t sorted = r.U8();
       if (r.ok()) feed(ids, n);
       if (r.ok()) {
-        WriteResult(&w, engine_.GetFullNeighbor(ids, static_cast<int>(n),
+        WriteResult(&w, eng.GetFullNeighbor(ids, static_cast<int>(n),
                                                 etypes, static_cast<int>(net),
                                                 sorted != 0));
       }
@@ -453,7 +504,7 @@ void Service::Dispatch(const char* req, size_t len,
       std::vector<float> ow(total);
       std::vector<int32_t> ot(total);
       if (r.ok() && k >= 0)
-        engine_.GetTopKNeighbor(ids, static_cast<int>(n), etypes,
+        eng.GetTopKNeighbor(ids, static_cast<int>(n), etypes,
                                 static_cast<int>(net), k, def, oid.data(),
                                 ow.data(), ot.data());
       w.Arr(oid);
@@ -475,7 +526,7 @@ void Service::Dispatch(const char* req, size_t len,
       if (OversizedResult(n * row, reply)) return;
       std::vector<float> out(static_cast<size_t>(n * row));
       if (r.ok() && nf == nd)
-        engine_.GetDenseFeature(ids, static_cast<int>(n), fids, dims,
+        eng.GetDenseFeature(ids, static_cast<int>(n), fids, dims,
                                 static_cast<int>(nf), out.data());
       w.Arr(out);
       break;
@@ -494,7 +545,7 @@ void Service::Dispatch(const char* req, size_t len,
       if (OversizedResult(n * row, reply)) return;
       std::vector<float> out(static_cast<size_t>(n * row));
       if (r.ok() && n == n2 && n == n3 && nf == nd)
-        engine_.GetEdgeDenseFeature(src, dst, types, static_cast<int>(n),
+        eng.GetEdgeDenseFeature(src, dst, types, static_cast<int>(n),
                                     fids, dims, static_cast<int>(nf),
                                     out.data());
       w.Arr(out);
@@ -506,7 +557,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* fids = r.Arr<int32_t>(&nf);
       if (r.ok()) feed(ids, n);
       if (r.ok())
-        WriteResult(&w, engine_.GetSparseFeature(ids, static_cast<int>(n),
+        WriteResult(&w, eng.GetSparseFeature(ids, static_cast<int>(n),
                                                  fids, static_cast<int>(nf)));
       break;
     }
@@ -518,7 +569,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* fids = r.Arr<int32_t>(&nf);
       if (r.ok()) feed(src, n);
       if (r.ok() && n == n2 && n == n3)
-        WriteResult(&w, engine_.GetEdgeSparseFeature(
+        WriteResult(&w, eng.GetEdgeSparseFeature(
                             src, dst, types, static_cast<int>(n), fids,
                             static_cast<int>(nf)));
       break;
@@ -529,7 +580,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* fids = r.Arr<int32_t>(&nf);
       if (r.ok()) feed(ids, n);
       if (r.ok())
-        WriteResult(&w, engine_.GetBinaryFeature(ids, static_cast<int>(n),
+        WriteResult(&w, eng.GetBinaryFeature(ids, static_cast<int>(n),
                                                  fids, static_cast<int>(nf)));
       break;
     }
@@ -541,7 +592,7 @@ void Service::Dispatch(const char* req, size_t len,
       const int32_t* fids = r.Arr<int32_t>(&nf);
       if (r.ok()) feed(src, n);
       if (r.ok() && n == n2 && n == n3)
-        WriteResult(&w, engine_.GetEdgeBinaryFeature(
+        WriteResult(&w, eng.GetEdgeBinaryFeature(
                             src, dst, types, static_cast<int>(n), fids,
                             static_cast<int>(nf)));
       break;
@@ -562,7 +613,70 @@ void Service::Dispatch(const char* req, size_t len,
     *reply = std::move(e.buf());
     return;
   }
+  if (stamp) {
+    uint64_t cur = epochs_.current();
+    std::memcpy(&w.buf()[1], &cur, 8);
+  }
   *reply = std::move(w.buf());
+}
+
+bool Service::LoadDelta(const std::string& path, uint64_t* new_epoch,
+                        std::string* error) {
+  // One flip at a time per shard: concurrent kLoadDelta requests queue
+  // here. Readers never block — they keep pinning whatever epoch is
+  // current while the merge builds off to the side.
+  std::lock_guard<std::mutex> l(delta_mu_);
+  Counters& ctr = Counters::Global();
+  auto fail = [&](const std::string& msg) {
+    *error = msg;
+    ctr.Add(kCtrDeltaLoadFail);
+    return false;
+  };
+  // kFaultDeltaLoad: the read/parse leg forced to fail or slowed — the
+  // window the chaos soak races SIGKILL into.
+  if (FaultHit(kFaultDeltaLoad))
+    return fail("delta_load failpoint fired for " + path);
+  std::string data;
+  {
+    std::ifstream f(path, std::ios::binary | std::ios::ate);
+    if (!f) return fail("cannot read delta " + path);
+    std::streamsize size = f.tellg();
+    f.seekg(0);
+    // eg-lint: allow(wire-count-alloc) sized by tellg of an already-open
+    // local file; bad_alloc surfaces as a handler error reply
+    data.resize(static_cast<size_t>(size));
+    if (!f.read(data.data(), size))
+      return fail("cannot read delta " + path);
+  }
+  DeltaFile d;
+  std::string err;
+  if (!d.Parse(data.data(), data.size(), &err) || !d.Validate(&err))
+    return fail(path + ": " + err);
+  ShardOwnership own{shard_idx_, shard_num_, num_partitions_};
+  if (!FilterDeltaToShard(&d, own, &err))
+    return fail(path + ": " + err);
+  if (!deltas_.empty() && d.seq <= deltas_.back().seq)
+    return fail(path + ": delta seq " + std::to_string(d.seq) +
+                " not above applied seq " +
+                std::to_string(deltas_.back().seq));
+  deltas_.push_back(std::move(d));
+  std::shared_ptr<Engine> merged;
+  if (!BuildMergedEngine(base_files_, deltas_, &merged, &err)) {
+    deltas_.pop_back();
+    return fail(path + ": " + err);
+  }
+  // kFaultEpochFlip: refuse (err) or stall (delay) the publish itself,
+  // AFTER the merged engine was built — the shard keeps serving its
+  // current epoch on refusal.
+  if (FaultHit(kFaultEpochFlip)) {
+    deltas_.pop_back();
+    return fail(path + ": epoch_flip failpoint refused the flip");
+  }
+  merged->set_epoch(epochs_.current() + 1);
+  uint64_t e = epochs_.Flip(std::move(merged));
+  announced_epoch_.store(e, std::memory_order_release);
+  *new_epoch = e;
+  return true;
 }
 
 }  // namespace eg

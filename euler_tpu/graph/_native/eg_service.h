@@ -22,11 +22,14 @@
 #define EG_SERVICE_H_
 
 #include <atomic>
+#include <mutex>
 #include <string>
 #include <thread>
+#include <vector>
 
 #include "eg_admission.h"
 #include "eg_engine.h"
+#include "eg_epoch.h"
 
 namespace eg {
 
@@ -64,17 +67,41 @@ class Service {
   int port() const { return port_; }
   int shard_idx() const { return shard_idx_; }
   const std::string& error() const { return error_; }
-  const Engine& engine() const { return engine_; }
+
+  // ---- snapshot epochs (eg_epoch.h) ----
+  // Merge one `<prefix>.delta.<n>` file over base + every delta applied
+  // so far, flip the serving epoch to the fresh snapshot, and announce
+  // it (reply stamps + registry heartbeat). Serialized per shard —
+  // concurrent loads queue on delta_mu_. False + *error on read/parse/
+  // validate/merge failure or a delta_load / epoch_flip failpoint; the
+  // current epoch keeps serving and delta_loads_failed counts it.
+  bool LoadDelta(const std::string& path, uint64_t* new_epoch,
+                 std::string* error);
+  uint64_t epoch() const { return epochs_.current(); }
 
  private:
   // Leave discovery: unlink the flat-file entry and/or stop the
   // heartbeat thread (which UNREGs on its way out). Idempotent.
   void Deregister();
   // Decode one request body (envelope already stripped by the admission
-  // worker), run it on the engine, encode the reply.
-  void Dispatch(const char* req, size_t len, std::string* reply) const;
+  // worker), run it on the pinned epoch's engine, encode the reply
+  // (stamped with the current epoch for v4 requests).
+  void Dispatch(const char* req, size_t len, const Envelope& env,
+                std::string* reply);
 
-  Engine engine_;
+  // Current + previous snapshot; every Dispatch pins one (v4 requests
+  // may pin the previous epoch so in-flight multi-hop steps finish on
+  // the snapshot they started on).
+  EpochTable epochs_;
+  std::mutex delta_mu_;  // serializes LoadDelta (one flip at a time)
+  // Every delta applied so far, ascending seq — each flip re-merges
+  // base_files_ + all of these so the snapshot is bit-identical to a
+  // fresh merged load.
+  std::vector<DeltaFile> deltas_ EG_GUARDED_BY(delta_mu_);
+  std::vector<std::string> base_files_;
+  // What the registry heartbeat announces; stored (not read from
+  // epochs_) so the beat thread never touches the flip path.
+  std::atomic<uint64_t> announced_epoch_{0};
   std::string error_;
   // Raw placement artifact from the data dir (eg_placement.h), served
   // verbatim through kPlacement so clients route by the same map the

@@ -197,6 +197,21 @@ enum CounterId : int {
   kCtrAsyncSubmit,
   kCtrAsyncInflightPeak,
   kCtrAsyncContinuation,
+  // Snapshot-epoch ledger (eg_epoch.h): the mutable-graph refresh path.
+  // epoch_flips counts published flips (a delta load that swapped the
+  // serving snapshot); epoch_drains counts superseded snapshots whose
+  // last pinned reader released (counted once per retired epoch — flips
+  // with no in-flight readers drain immediately, so every flip
+  // eventually produces exactly one drain while the snapshot is still
+  // in the keep window); epoch_stale_hits_evicted counts client cache
+  // entries (feature/neighbor/sample) evicted on a generation-stale
+  // hit; delta_loads_failed counts kLoadDelta requests refused (parse/
+  // validate/merge failure, or the delta_load/epoch_flip failpoints) —
+  // the graph keeps serving its current epoch in every failure case.
+  kCtrEpochFlip,
+  kCtrEpochDrain,
+  kCtrEpochStaleEvict,
+  kCtrDeltaLoadFail,
   kCtrCount,
 };
 
@@ -216,6 +231,8 @@ const char* const kCounterNames[kCtrCount] = {
     "device_compiles",    "device_recompiles",
     "serve_recompiles",   "h2d_bytes",        "d2h_bytes",
     "async_submits",      "async_inflight_peak", "async_continuations",
+    "epoch_flips",        "epoch_drains",
+    "epoch_stale_hits_evicted", "delta_loads_failed",
 };
 
 class Counters {

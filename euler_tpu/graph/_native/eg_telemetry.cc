@@ -270,6 +270,9 @@ std::string Telemetry::Json(int shard, const TelemetryGauges* g) const {
     o.push_back(',');
     AppendKey(&o, "draining");
     AppendI64(&o, g->draining);
+    o.push_back(',');
+    AppendKey(&o, "epoch");
+    AppendI64(&o, g->epoch);
     o.push_back('}');
   }
 

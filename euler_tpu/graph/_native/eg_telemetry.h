@@ -70,8 +70,8 @@ const char* const kHistKindNames[kHistKindCount] = {
 const bool kHistKindPerOp[kHistKindCount] = {true, true, false, false,
                                              false};
 
-// Per-op cell slots: wire ops 1..20 plus slot 0 for out-of-range ops.
-constexpr int kHistOpSlots = 21;
+// Per-op cell slots: wire ops 1..21 plus slot 0 for out-of-range ops.
+constexpr int kHistOpSlots = 22;
 
 // Fixed-order wire-op names (index == WireOp value; slot 0 = unknown).
 const char* const kWireOpNames[kHistOpSlots] = {
@@ -85,7 +85,7 @@ const char* const kWireOpNames[kHistOpSlots] = {
     "edge_binary_feature", "node_weight",
     "sample_neighbor_uniq", "stats",
     "history",        "heat",
-    "placement",
+    "placement",      "load_delta",
 };
 
 enum SpanSide : uint8_t { kSpanClient = 0, kSpanServer = 1 };
@@ -128,6 +128,7 @@ struct TelemetryGauges {
   int queue_depth = 0;  // ready conns waiting for a worker
   int conns = 0;        // admitted open connections
   int draining = 0;     // 1 while Drain() is in progress / done
+  int64_t epoch = 0;    // current serving snapshot epoch (eg_epoch.h)
 };
 
 inline int64_t TelemetryNowUs() {

@@ -98,12 +98,13 @@ bool RecvFrame(int fd, std::string* payload) {
   return RecvFrameEx(fd, payload) == IoStatus::kOk;
 }
 
-// ---- wire v2 request envelope ----
+// ---- versioned request envelope ----
 
 std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms,
-                         uint8_t version, uint64_t trace_id) {
+                         uint8_t version, uint64_t trace_id,
+                         uint64_t epoch) {
   std::string out;
-  out.reserve(payload.size() + 18);
+  out.reserve(payload.size() + 26);
   out.push_back(static_cast<char>(kWireEnvelope));
   out.push_back(static_cast<char>(version));
   char buf[8];
@@ -111,6 +112,10 @@ std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms,
   out.append(buf, 8);
   if (version >= 3) {
     std::memcpy(buf, &trace_id, 8);
+    out.append(buf, 8);
+  }
+  if (version >= 4) {
+    std::memcpy(buf, &epoch, 8);
     out.append(buf, 8);
   }
   out.append(payload);
@@ -127,13 +132,19 @@ bool PeekEnvelope(const std::string& payload, Envelope* env) {
   env->version = static_cast<uint8_t>(payload[1]);
   std::memcpy(&env->deadline_ms, payload.data() + 2, 8);
   env->body_off = 10;
-  if (env->version == 3) {
-    // exactly v3 reads the trace field; FUTURE versions keep the common
-    // 10-byte parse (the server answers kStatusBadVersion before the
-    // body offset could matter, so an unknown layout never misparses)
+  if (env->version == 3 || env->version == 4) {
+    // exactly the versions this build KNOWS read past the common header;
+    // FUTURE versions keep the 10-byte parse (the server answers
+    // kStatusBadVersion before the body offset could matter, so an
+    // unknown layout never misparses)
     if (payload.size() < 18) return false;
     std::memcpy(&env->trace_id, payload.data() + 10, 8);
     env->body_off = 18;
+    if (env->version == 4) {
+      if (payload.size() < 26) return false;
+      std::memcpy(&env->epoch, payload.data() + 18, 8);
+      env->body_off = 26;
+    }
   }
   return true;
 }

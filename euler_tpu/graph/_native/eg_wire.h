@@ -12,30 +12,41 @@
 //          [u8 0xE7][u8 2][i64 deadline_ms][u8 op][args...]        (wire v2)
 //          [u8 0xE7][u8 3][i64 deadline_ms][u64 trace_id][u8 op][args...]
 //                                                                  (wire v3)
+//          [u8 0xE7][u8 4][i64 deadline_ms][u64 trace_id][u64 epoch]
+//          [u8 op][args...]                                        (wire v4)
 // Reply:   payload = [u8 status][body...]   status 0 = ok, else see
 //          WireStatus (1 = error string; 2 BUSY; 3 DEADLINE; 4 BADVERSION).
+//          To a v4 request, an OK reply body is prefixed with the shard's
+//          CURRENT epoch: [u8 0][u64 epoch][body...] — the passive flip
+//          announcement clients learn graph refreshes from (eg_epoch.h).
+//          Error/BUSY/DEADLINE/BADVERSION replies are never stamped, so
+//          their layout stays identical across all versions.
 //
 // Version negotiation (backward compatible in every direction, all
 // passive — no extra handshake round trip, ever):
 //   * current clients wrap every request in the 0xE7 envelope, stamping
 //     the call's REMAINING deadline budget (ms) so the server can
-//     refuse requests whose answers nobody will read, and (v3) the
-//     call's trace id so both sides' slow-span journals correlate
-//     (eg_telemetry.h).
+//     refuse requests whose answers nobody will read, (v3) the call's
+//     trace id so both sides' slow-span journals correlate
+//     (eg_telemetry.h), and (v4) the EPOCH the op pinned at start —
+//     0 = current; a nonzero epoch asks the shard to serve that
+//     snapshot if it still holds it (in-flight multi-hop steps finish
+//     against the snapshot they started on, eg_epoch.h).
 //   * current servers accept ALL forms: a first byte in the op range is
 //     a v1 request (no deadline, no trace); 0xE7 opens an envelope,
 //     whose version byte selects the header layout (v2 = 10 bytes,
-//     v3 = 18). An envelope whose version is above the server's speaks
-//     back kStatusBadVersion with a plain-text explanation — never a
-//     hang or a crash.
+//     v3 = 18, v4 = 26). An envelope whose version is above the
+//     server's speaks back kStatusBadVersion with a plain-text
+//     explanation — never a hang or a crash.
 //   * a v1 server sees 0xE7 as an unknown op and answers its stock
 //     "unknown op 231" error with the connection still healthy; clients
 //     recognize exactly that reply on a replica's first exchange, mark
 //     the replica v1 (`wire_downgrades` counter), and resend the raw
-//     request on the same connection. A v2-only server instead answers
-//     kStatusBadVersion to the v3 envelope; the client pins the replica
-//     at v2 (deadline propagates, trace id simply doesn't) and resends
-//     — same counter, same single-exchange cost.
+//     request on the same connection. A v2- or v3-only server instead
+//     answers kStatusBadVersion to the v4 envelope; the client steps
+//     the replica down one version (4 -> 3 -> 2) and resends — one
+//     `wire_downgrades` count per replica pinned below kWireVersion,
+//     at most two extra exchanges on its first call ever.
 #ifndef EG_WIRE_H_
 #define EG_WIRE_H_
 
@@ -104,13 +115,21 @@ enum WireOp : uint8_t {
   // genuine pre-placement server, so one client fallback path (degrade
   // to hash routing) covers old servers and old data alike.
   kPlacement = 20,
+  // Snapshot-epoch delta load (eg_epoch.h): merge one delta file into a
+  // fresh immutable snapshot and flip the shard's serving epoch to it.
+  // Request: [Str path] — a shard-local `<prefix>.delta.<n>` file.
+  // Reply: [u64 new_epoch]. Serialized per shard (concurrent loads
+  // queue); failure (parse/validate/merge, or the delta_load/epoch_flip
+  // failpoints) answers an error string, counts delta_loads_failed,
+  // and leaves the current epoch serving.
+  kLoadDelta = 21,
 };
 
 constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB sanity cap
 
 // Highest request-envelope version this build speaks; stamped by clients
 // and checked by servers (see the negotiation contract above).
-constexpr uint8_t kWireVersion = 3;
+constexpr uint8_t kWireVersion = 4;
 // Request-envelope marker. Deliberately far outside the op range so a v1
 // server classifies an enveloped request as an unknown op (clean error)
 // instead of misparsing it.
@@ -131,15 +150,17 @@ struct Envelope {
   bool versioned = false;   // payload opened with kWireEnvelope
   uint8_t version = 1;      // stamped version (1 when not versioned)
   int64_t deadline_ms = -1; // client's remaining budget; <0 = none stamped
-  uint64_t trace_id = 0;    // v3 trace id; 0 = none propagated
+  uint64_t trace_id = 0;    // v3+ trace id; 0 = none propagated
+  uint64_t epoch = 0;       // v4 pinned epoch; 0 = serve current
   size_t body_off = 0;      // offset of the v1 [u8 op][args...] body
 };
 
-// [kWireEnvelope][u8 version][i64 deadline_ms]([u64 trace_id] for v3)
-// + payload. `version` must be 2 or 3 (v2 has no trace-id field).
+// [kWireEnvelope][u8 version][i64 deadline_ms]([u64 trace_id] for v3+)
+// ([u64 epoch] for v4) + payload. `version` must be 2, 3 or 4 (v2 has
+// no trace-id field, only v4 carries the epoch pin).
 std::string WrapEnvelope(const std::string& payload, int64_t deadline_ms,
                          uint8_t version = kWireVersion,
-                         uint64_t trace_id = 0);
+                         uint64_t trace_id = 0, uint64_t epoch = 0);
 // Classify a request payload; false only for a TRUNCATED envelope (marker
 // present but header short for its stamped version) — a payload without
 // the marker is v1, ok. Versions above kWireVersion parse the common

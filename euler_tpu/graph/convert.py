@@ -326,6 +326,146 @@ def convert_dicts(
     )
 
 
+# ---- snapshot-epoch delta files (eg_epoch.h) ----
+# `<prefix>.delta.<n>` carries one graph refresh: removed node ids,
+# removed edge keys, and a standard .dat block stream of added/replaced
+# records (full replacement — GraphStore::Build's first-occurrence-wins
+# dedup makes the newest delta authoritative when stagings merge
+# newest-first). Layout, all little-endian, array = i64 count + raw
+# elements (WireWriter::Arr), string = i64 length + bytes:
+#   "EGD1" [u32 version=1] [u64 seq]
+#   [arr u64 removed_nodes]
+#   [arr u64 rme_src] [arr u64 rme_dst] [arr i32 rme_type]
+#   [str dat_blob]
+
+
+def pack_delta(
+    seq: int,
+    removed_nodes: list[int],
+    removed_edges: list[tuple[int, int, int]],
+    dat_blob: bytes,
+) -> bytes:
+    """Serialize one delta payload (format above). ``removed_edges`` are
+    (src, dst, edge_type) keys; ``dat_blob`` a .dat block stream of the
+    added/replaced node records."""
+
+    def arr(fmt: str, vals) -> bytes:
+        vals = list(vals)
+        return struct.pack("<q", len(vals)) + struct.pack(
+            "<%d%s" % (len(vals), fmt), *vals
+        )
+
+    u64 = lambda v: int(v) & 0xFFFFFFFFFFFFFFFF  # noqa: E731
+    return b"".join(
+        [
+            b"EGD1",
+            struct.pack("<IQ", 1, int(seq)),
+            arr("Q", (u64(v) for v in removed_nodes)),
+            arr("Q", (u64(e[0]) for e in removed_edges)),
+            arr("Q", (u64(e[1]) for e in removed_edges)),
+            arr("i", (int(e[2]) for e in removed_edges)),
+            struct.pack("<q", len(dat_blob)),
+            dat_blob,
+        ]
+    )
+
+
+def _index_nodes(nodes: list[dict], label: str) -> dict[int, dict]:
+    """Index nodes by id, rejecting duplicates LOUDLY — a duplicate in a
+    delta input is a contradictory edit (two different replacement rows
+    for one node; whichever won would be arbitrary)."""
+    out: dict[int, dict] = {}
+    for node in nodes:
+        nid = int(node["node_id"])
+        if nid in out:
+            raise ValueError(
+                f"duplicate node_id {nid} in {label} input — a delta "
+                "must carry exactly one replacement record per node"
+            )
+        out[nid] = node
+    return out
+
+
+def _edge_keys(node: dict, label: str) -> set[tuple[int, int, int]]:
+    """The (src, dst, type) edge-record keys of one node, rejecting
+    duplicates — two records for one key is a contradictory edit (their
+    weights/features could differ and one would silently win)."""
+    keys: set[tuple[int, int, int]] = set()
+    for e in node.get("edge", []) or []:
+        k = (int(e["src_id"]), int(e["dst_id"]), int(e["edge_type"]))
+        if k in keys:
+            raise ValueError(
+                f"duplicate edge record {k} in {label} input — a delta "
+                "must carry exactly one record per (src, dst, type)"
+            )
+        keys.add(k)
+    return keys
+
+
+def make_delta(
+    old_nodes: list[dict], new_nodes: list[dict], meta: dict
+) -> tuple[list[int], list[tuple[int, int, int]], bytes]:
+    """Diff two JSON-lines snapshots into one delta payload:
+    (removed_nodes, removed_edges, dat_blob).
+
+    Changed nodes are detected by canonical block bytes (pack_block), so
+    a reordered-but-identical JSON line emits nothing. Edge-record
+    removals are emitted only for edges entirely gone from a surviving
+    node — a modified edge rides the node's replacement record instead
+    (removing AND re-adding one key is the contradiction the native
+    Validate rejects). Removed nodes drop their own edge records
+    native-side (endpoint removal), so no keys are emitted for them."""
+    old = _index_nodes(old_nodes, "old")
+    new = _index_nodes(new_nodes, "new")
+    removed_nodes = sorted(set(old) - set(new))
+    removed_edges: list[tuple[int, int, int]] = []
+    blocks: list[bytes] = []
+    for nid in sorted(new):
+        nb = pack_block(new[nid], meta)
+        if nid not in old:
+            blocks.append(nb)
+            continue
+        ob = pack_block(old[nid], meta)
+        gone = sorted(
+            _edge_keys(old[nid], "old") - _edge_keys(new[nid], "new")
+        )
+        removed_edges.extend(gone)
+        if ob != nb:
+            blocks.append(nb)
+    return removed_nodes, removed_edges, b"".join(blocks)
+
+
+def convert_delta(
+    meta_path: str,
+    old_input_path: str,
+    new_input_path: str,
+    output_prefix: str,
+    seq: int = 1,
+) -> str:
+    """Diff two JSON-lines graphs into ``<output_prefix>.delta.<seq>``
+    (the refresh payload shards merge and flip to; eg_epoch.h). Raises
+    on duplicate/contradictory edits. Returns the written path."""
+
+    def read_lines(path: str) -> list[dict]:
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out
+
+    with open(meta_path) as f:
+        meta = json.load(f)
+    removed_nodes, removed_edges, blob = make_delta(
+        read_lines(old_input_path), read_lines(new_input_path), meta
+    )
+    path = "%s.delta.%d" % (output_prefix, int(seq))
+    with open(path, "wb") as f:
+        f.write(pack_delta(seq, removed_nodes, removed_edges, blob))
+    return path
+
+
 def main() -> None:
     import argparse
 
@@ -341,7 +481,21 @@ def main() -> None:
                         "co-location + a <prefix>.placement artifact "
                         "shards serve to clients (locality-aware "
                         "routing, ROADMAP item 5)"))
+    ap.add_argument("--delta-from", default=None, metavar="OLD_INPUT", help=(
+        "emit a snapshot-epoch delta instead of partitions: diff "
+        "OLD_INPUT (the currently-served JSON-lines graph) against "
+        "INPUT (the refreshed one) into <output_prefix>.delta.<seq> — "
+        "the payload `service --load_delta` / Graph.load_delta merge "
+        "and flip to (eg_epoch.h). Duplicate or contradictory edits "
+        "are rejected loudly"))
+    ap.add_argument("--delta-seq", type=int, default=1, help=(
+        "sequence number of the emitted delta (deltas apply in seq "
+        "order; name and header both carry it)"))
     args = ap.parse_args()
+    if args.delta_from is not None:
+        print(convert_delta(args.meta, args.delta_from, args.input,
+                            args.output_prefix, seq=args.delta_seq))
+        return
     for p in convert(args.meta, args.input, args.output_prefix,
                      args.partitions, placement=args.placement):
         print(p)

@@ -142,6 +142,7 @@ class Graph:
         postmortem_dir: str | None = None,
         cache_dir: str | None = None,
         stream: bool | None = None,
+        delta: str | list[str] | None = None,
         config: str | None = None,
         init: str | None = None,
     ):
@@ -160,7 +161,7 @@ class Graph:
             "cache_policy", "placement", "strict", "coalesce",
             "chunk_ids", "dispatch_workers", "wire_version", "telemetry",
             "slow_spans", "heat", "heat_topk", "blackbox", "devprof",
-            "postmortem_dir", "cache_dir", "stream", "init",
+            "postmortem_dir", "cache_dir", "stream", "delta", "init",
         }
         unknown = set(cfg) - known
         if unknown:
@@ -268,6 +269,13 @@ class Graph:
         stream = pick("stream", stream, False)
         if isinstance(stream, str):
             stream = str2bool(stream)
+        # snapshot-epoch delta files (eg_epoch.h; `<prefix>.delta.<n>`,
+        # see convert.py --delta-from): applied over the base load at
+        # connect, leaving the engine at epoch = len(delta)
+        delta = pick("delta", delta, None)
+        if isinstance(delta, str):
+            delta = [s.strip() for s in delta.replace(";", ",").split(",")
+                     if s.strip()]
         init = str(pick("init", init, "eager")).lower()
         if mode not in ("local", "remote"):
             raise ValueError("mode must be 'local' or 'remote'")
@@ -314,6 +322,16 @@ class Graph:
                         "configures the remote client's request path; "
                         "the embedded engine reads local memory)"
                     )
+        if delta and mode != "local":
+            # never dropped silently: a remote client holds no graph data
+            # to merge — shards apply their own deltas (Graph.load_delta
+            # per shard, or `service --load_delta`)
+            raise ValueError(
+                "delta= applies to mode='local' graphs (remote shards "
+                "merge their own delta files — use load_delta(path, "
+                "shard=...) or `python -m euler_tpu.graph.service "
+                "--load_delta`; see DEPLOY.md 'Rolling graph refresh')"
+            )
         if stream and mode != "local":
             # never dropped silently: remote mode reads no graph data
             # itself, so accepting the flag would just mislead
@@ -357,9 +375,13 @@ class Graph:
             dispatch_workers=dispatch_workers, wire_version=wire_version,
             telemetry=telemetry, slow_spans=slow_spans, heat=heat,
             heat_topk=heat_topk, cache_dir=cache_dir, stream=bool(stream),
+            delta=delta,
         )
         self.mode = mode
         self._strict = bool(strict) if strict is not None else False
+        # local-mode delta chain applied so far (load_delta re-sends the
+        # whole chain per flip; seeded by the delta= config key)
+        self._applied_deltas: list[str] = list(delta) if delta else []
         if init == "eager":
             self._connect()
 
@@ -536,6 +558,15 @@ class Graph:
             err = self._lib.eg_last_error().decode()
             self._lib.eg_destroy(h)
             raise RuntimeError(f"graph load failed: {err}")
+        if p.get("delta"):
+            # merge the delta chain over the fresh base: a failed merge
+            # fails the whole connect (a graph silently missing its
+            # updates is worse than no graph)
+            joined = ";".join(p["delta"])
+            if self._lib.eg_load_deltas(h, joined.encode()) != 0:
+                err = self._lib.eg_last_error().decode()
+                self._lib.eg_destroy(h)
+                raise RuntimeError(f"delta load failed: {err}")
         self._handle = h
 
     @property
@@ -586,6 +617,74 @@ class Graph:
             self._h, _ptr(arr, _U64P), len(arr), _ptr(out, _I32P)
         )
         return out
+
+    # ---- snapshot epochs (eg_epoch.h; DEPLOY.md "Rolling graph
+    # refresh") ----
+    def epoch(self) -> int:
+        """Current snapshot epoch. Local: the epoch the embedded engine's
+        snapshot was built at (0 = base load, N = after N deltas).
+        Remote: the max epoch any shard has announced so far — learned
+        passively from v4 reply stamps and registry heartbeats, so it
+        can lag a fresh flip by one call/poll."""
+        return int(self._lib.eg_graph_epoch(self._h))
+
+    def shard_epoch(self, shard: int) -> int:
+        """Last epoch announced by one shard (remote mode; 0 = never
+        flipped or not yet observed)."""
+        if self.mode != "remote":
+            raise ValueError(
+                "shard_epoch() applies to mode='remote' graphs (a local "
+                "graph has exactly one epoch — use epoch())"
+            )
+        return int(self._lib.eg_remote_epoch(self._h, shard))
+
+    @property
+    def cache_gen(self) -> int:
+        """The client's cache generation (remote mode; 0 for local):
+        bumped once per observed epoch raise on any shard. Python-side
+        caches (euler_tpu/serving/microbatch.py) key entries by this,
+        exactly like the native feature/neighbor caches."""
+        if self.mode != "remote":
+            return 0
+        return int(self._lib.eg_remote_cache_gen(self._h))
+
+    def load_delta(self, path: str, shard: int | None = None) -> int:
+        """Apply one delta file and flip to a fresh snapshot; returns the
+        new epoch.
+
+        Local graphs take the delta path directly (shard= must be None).
+        Remote graphs ask ONE shard to merge a file on the SHARD's
+        filesystem (shard= required) — roll through shards one at a time
+        so the previous-epoch window covers in-flight multi-hop reads
+        (DEPLOY.md 'Rolling graph refresh'). Raises on parse/validation/
+        merge failure; the serving snapshot is untouched on failure."""
+        if self.mode == "remote":
+            if shard is None:
+                raise ValueError(
+                    "remote load_delta needs shard= (each shard merges "
+                    "its own delta file; roll through shards in turn)"
+                )
+            ep = self._lib.eg_remote_load_delta(
+                self._h, int(shard), path.encode()
+            )
+            if ep < 0:
+                raise RuntimeError(self._lib.eg_last_error().decode())
+            return int(ep)
+        if shard is not None:
+            raise ValueError(
+                "shard= applies to mode='remote' graphs (a local graph "
+                "merges the delta into its own embedded engine)"
+            )
+        # the native merge rebuilds base + the WHOLE chain (epoch = chain
+        # length), so successive local flips re-send every delta applied
+        # so far — the flipped snapshot stays bit-identical to a fresh
+        # load of the same merged inputs
+        chain = list(self._applied_deltas) + [path]
+        joined = ";".join(chain)
+        if self._lib.eg_load_deltas(self._h, joined.encode()) != 0:
+            raise RuntimeError(self._lib.eg_last_error().decode())
+        self._applied_deltas = chain
+        return self.epoch()
 
     def _check_strict(self):
         """Raise the pending strict-mode failure, if any. With

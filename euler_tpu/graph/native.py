@@ -70,6 +70,8 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_load_files, c.c_int, [p, c.POINTER(c.c_char_p), c.c_int])
     _sig(L.eg_load_buffers, c.c_int,
          [p, c.POINTER(c.c_void_p), u64p, c.POINTER(c.c_char_p), c.c_int])
+    _sig(L.eg_load_deltas, c.c_int, [p, c.c_char_p])
+    _sig(L.eg_graph_epoch, c.c_uint64, [p])
     _sig(L.eg_seed, None, [c.c_uint64])
     _sig(L.eg_stat_count, c.c_int, [])
     _sig(L.eg_stat_name, c.c_char_p, [c.c_int])
@@ -133,6 +135,9 @@ def lib() -> ctypes.CDLL:
     _sig(L.eg_remote_has_placement, c.c_int, [p])
     _sig(L.eg_remote_route, None, [p, u64p, c.c_int, i32p])
     _sig(L.eg_remote_strict_error, c.c_int, [p, c.c_char_p, c.c_int])
+    _sig(L.eg_remote_epoch, c.c_uint64, [p, c.c_int])
+    _sig(L.eg_remote_cache_gen, c.c_uint64, [p])
+    _sig(L.eg_remote_load_delta, c.c_int64, [p, c.c_int, c.c_char_p])
     _sig(
         L.eg_remote_sample_async,
         c.c_int,
@@ -151,6 +156,8 @@ def lib() -> ctypes.CDLL:
     )
     _sig(L.eg_service_port, c.c_int, [p])
     _sig(L.eg_service_drain, None, [p, c.c_int])
+    _sig(L.eg_service_load_delta, c.c_int64, [p, c.c_char_p])
+    _sig(L.eg_service_epoch, c.c_uint64, [p])
     _sig(L.eg_service_stop, None, [p])
     _sig(L.eg_registry_start, p, [c.c_char_p, c.c_int, c.c_int])
     _sig(L.eg_registry_port, c.c_int, [p])
@@ -277,7 +284,10 @@ def counters() -> dict:
     remote hot path's communication-win ledger: {"ids_deduped": n,
     "cache_hits": n, "cache_misses": n, "rpc_chunks": n}
     (ids_on_wire = ids_requested - ids_deduped - cache_hits; see
-    FAULTS.md for per-counter semantics). All keys always present (zero
+    FAULTS.md for per-counter semantics). Snapshot-epoch side —
+    the graph-refresh ledger: {"epoch_flips": n, "epoch_drains": n,
+    "epoch_stale_hits_evicted": n, "delta_loads_failed": n} (flips ==
+    drains once quiescent; see FAULTS.md). All keys always present (zero
     included), so dashboards and the chaos soak can diff snapshots
     without key existence checks."""
     L = lib()

@@ -98,6 +98,26 @@ class GraphService:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
+    @property
+    def epoch(self) -> int:
+        """Current serving snapshot epoch (0 until the first delta
+        flip; eg_epoch.h)."""
+        if not getattr(self, "_h", None):
+            return 0
+        return int(self._lib.eg_service_epoch(self._h))
+
+    def load_delta(self, path: str) -> int:
+        """Merge one `<prefix>.delta.<n>` file (convert.py --delta-from)
+        into a fresh snapshot and flip the serving epoch — in-flight and
+        previous-epoch-pinned requests keep reading the old snapshot
+        until they drain (DEPLOY.md 'Rolling graph refresh'). Returns
+        the new epoch; raises on parse/validation/merge failure, with
+        the old snapshot still serving (counted delta_loads_failed)."""
+        ep = self._lib.eg_service_load_delta(self._h, path.encode())
+        if ep < 0:
+            raise RuntimeError(self._lib.eg_last_error().decode())
+        return int(ep)
+
     def drain(self, grace_ms: int = 0) -> None:
         """Graceful rolling-restart half: deregister from discovery,
         stop accepting, let in-flight requests finish (bounded by
@@ -159,6 +179,11 @@ def main() -> None:
     ap.add_argument("--blackbox", type=int, default=None, help=(
         "flight-recorder kill-switch: 0 disables ring recording AND "
         "suppresses the postmortem dump (default: on)"))
+    ap.add_argument("--load_delta", action="append", default=[], help=(
+        "delta file(s) (`<prefix>.delta.<n>`, convert.py --delta-from) "
+        "to merge right after the base load, flipping the serving epoch "
+        "once per file (repeatable; applied in the order given). The "
+        "shard starts serving only after every delta has flipped"))
     ap.add_argument("--fault", default="", help=(
         "deterministic failpoint spec injected in THIS shard process "
         "(service_reply/recv_frame/handler_stall/busy_force/... — see "
@@ -182,6 +207,10 @@ def main() -> None:
         postmortem_dir=args.postmortem_dir,
         blackbox=None if args.blackbox is None else bool(args.blackbox),
     )
+    for dpath in args.load_delta:
+        ep = svc.load_delta(dpath)
+        print(f"shard {svc.shard_idx} applied {dpath} -> epoch {ep}",
+              flush=True)
     print(
         f"graph shard {svc.shard_idx}/{svc.shard_num} serving on"
         f" {svc.address}",

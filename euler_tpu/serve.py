@@ -199,18 +199,30 @@ class EmbedServer:
 
     def _block(self, nid: int) -> dict:
         """One id's sampled model inputs — drawn once with an
-        id-derived seed, then cached (hot ids sample zero times)."""
+        id-derived seed, then cached (hot ids sample zero times).
+
+        Entries are keyed by the graph client's cache generation
+        (Graph.cache_gen, bumped on every observed epoch flip): a hit
+        sampled before a rolling graph refresh evicts and resamples
+        against the new snapshot (counted epoch_stale_hits_evicted, the
+        same ledger the native feature/neighbor caches use), so the
+        bit-stability promise holds *within* an epoch — exactly the
+        window in which it is meaningful."""
+        gen = getattr(self.graph, "cache_gen", 0)
         with self._cache_lock:
-            blk = self._cache.get(nid)
-            if blk is not None:
-                self._cache.move_to_end(nid)
-                return blk
+            ent = self._cache.get(nid)
+            if ent is not None:
+                if ent[0] == gen:
+                    self._cache.move_to_end(nid)
+                    return ent[1]
+                del self._cache[nid]
+                native.counter_add("epoch_stale_hits_evicted", 1)
         native.lib().eg_seed(_id_seed(self.seed, nid))
         blk = self.model.sample_embed(
             self.graph, np.array([nid], dtype=np.int64)
         )
         with self._cache_lock:
-            self._cache[nid] = blk
+            self._cache[nid] = (gen, blk)
             while len(self._cache) > self.sample_cache:
                 self._cache.popitem(last=False)
         return blk

@@ -312,6 +312,9 @@ _GAUGE_FAMILIES = {
                     "Ready connections waiting for a worker"),
     "conns": ("eg_conns", "Admitted open connections"),
     "draining": ("eg_draining", "1 while the server drains"),
+    "epoch": ("eg_epoch",
+              "Current serving snapshot epoch (0 = base load; each "
+              "applied delta flips it up by one)"),
 }
 
 # Process resource gauges (eg_blackbox.h: sampled live for every dump,

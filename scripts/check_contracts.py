@@ -36,8 +36,11 @@ Passes (each individually testable, see tests/test_contracts.py):
           wait-predicate lambdas under an enclosing unique_lock;
           constructors/destructors are exempt (exclusive access).
   artifacts  no tracked `.o`/`.so`/`.a`/`.flavor` build artifacts; no
-          orphan objects whose source is gone (the stale eg_epoch.o
-          class ROADMAP recorded); .gitignore covers the artifact set.
+          orphan objects whose source is gone (the stale-object
+          incident ROADMAP recorded — an eg_epoch.o outliving its
+          source; eg_epoch.cc is real source now, so only a
+          SOURCELESS object is an orphan); .gitignore covers the
+          artifact set.
 
 Escapes: same grammar as check_native.py —
 
@@ -978,7 +981,8 @@ def pass_artifacts(chk: Checker):
                     "artifact-hygiene",
                     f"orphan object: {fname} has no matching .cc — a stale "
                     "object from a deleted source can shadow real symbols "
-                    "at link time (the eg_epoch.o class); delete it",
+                    "at link time (the stale-object incident ROADMAP "
+                    "recorded); delete it",
                 )
             )
     gi_path = os.path.join(chk.root, ".gitignore")

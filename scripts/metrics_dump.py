@@ -67,6 +67,7 @@ def dump_cluster(graph, as_json: bool = False) -> list:
             f" queue {g.get('queue_depth', '?')}"
             f" conns {g.get('conns', '?')}"
             f" draining {g.get('draining', '?')}"
+            f" epoch {g.get('epoch', 0)}"
         )
         rows = [
             (key.split(":", 1)[1], h)
@@ -203,7 +204,11 @@ def watch_cluster(graph, every_s: float, iterations: int | None = None,
                     f"busy {g.get('workers_active', '?')} "
                     f"queue {g.get('queue_depth', '?')} "
                     f"conns {g.get('conns', '?')} "
-                    f"draining {g.get('draining', '?')}")
+                    f"draining {g.get('draining', '?')} "
+                    # current serving snapshot epoch (eg_epoch.h): during
+                    # a rolling graph refresh (DEPLOY.md) the operator
+                    # watches this tick up shard by shard
+                    f"epoch {g.get('epoch', 0)}")
             res = data.get("resource", {})
             if res.get("device_mem_peak_bytes"):
                 line += (f" dev_mem {res.get('device_mem_bytes', 0) / 1e6:.0f}"
@@ -321,6 +326,7 @@ def run_smoke() -> int:
             watch_out = buf.getvalue()
             assert "served +" in watch_out, watch_out
             assert "/s)" in watch_out, watch_out
+            assert " epoch 0" in watch_out, watch_out  # per-shard column
             assert "input_stall 1.50ms/step" in watch_out, watch_out
             buf_raw = io.StringIO()
             watch_cluster(g, 0.05, iterations=1, out=buf_raw, raw=True)

@@ -116,6 +116,17 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_rolling_restart.py tests/test_wire_fuzz.py -q \
   -p no:cacheprovider || fail=1
 
+step "snapshot epochs: delta flips + failpoint arithmetic + live flip drill (DEPLOY.md 'Rolling graph refresh')"
+# eg_epoch: whole-step consistency under the depth-2 async ring, exact
+# delta_load/epoch_flip failpoint counters, contradictory-delta
+# refusals, then the live drill — GraphSAGE training while each shard
+# flips mid-flight, zero failed calls, loss parity on the unchanged
+# subgraph, post-flip reads bit-identical to a fresh merged load.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_epoch.py -q -p no:cacheprovider || fail=1
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python scripts/epoch_drill.py --smoke >/dev/null || fail=1
+
 step "serve: micro-batch parity + shedding + closed-loop load drill (DEPLOY.md 'Serving runbook')"
 # eg_serve: SLO math + batcher coalescing/shedding/deadline pins, the
 # bit-parity contract under concurrent mixed traffic, then the

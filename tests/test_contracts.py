@@ -293,6 +293,27 @@ def test_lock_fires_on_unguarded_async_slot_state(tree):
     )
 
 
+def test_lock_fires_on_unguarded_epoch_table_state(tree):
+    # the epoch keep-window (held_) is the handoff between the flip
+    # publisher (loader thread) and Pin() on every handler thread — a
+    # new reader skipping mu_ sees a half-mutated vector mid-flip,
+    # exactly the race the epoch TSAN round excludes (SANITIZERS.md).
+    # EpochSnapshot refcounts (pins/superseded/drain_counted) are
+    # atomics by design; held_ is the part the mutex protects.
+    with open(os.path.join(tree, NATIVE_REL, "eg_epoch.h"), "a") as f:
+        f.write(
+            "\nnamespace eg {\n"
+            "inline size_t EpochDriftProbe(EpochTable* t) {\n"
+            "  return t->held_.size();\n"
+            "}\n"
+            "}  // namespace eg\n"
+        )
+    vs = run_pass(tree, "lock")
+    assert any(
+        v.rule == "guarded-by" and "`held_`" in v.message for v in vs
+    )
+
+
 def test_lock_fires_on_unlocked_requires_call(tree):
     # calling an EG_REQUIRES(mu) helper without holding mu
     with open(os.path.join(tree, NATIVE_REL, "eg_heat.cc"), "a") as f:
@@ -325,11 +346,16 @@ def test_lock_escape_waives_with_reason(tree):
 
 
 def test_artifacts_fires_on_tracked_object_and_gitignore_gap(tree):
+    # eg_epoch.o is the historic stale-object incident ROADMAP recorded;
+    # now that eg_epoch.cc is a real source (the snapshot-epoch engine),
+    # its object is a legitimate make product — tracked-in-git is still
+    # a violation, but the ORPHAN rule must stay quiet for it. A
+    # sourceless object probes the orphan rule instead.
     subprocess.run(
         ["git", "init", "-q"], cwd=tree, check=True, capture_output=True
     )
-    stale = os.path.join(tree, NATIVE_REL, "eg_epoch.o")
-    with open(stale, "wb") as f:
+    built = os.path.join(tree, NATIVE_REL, "eg_epoch.o")
+    with open(built, "wb") as f:
         f.write(b"\x7fELF")
     subprocess.run(
         ["git", "add", "-f", os.path.join(NATIVE_REL, "eg_epoch.o")],
@@ -337,12 +363,24 @@ def test_artifacts_fires_on_tracked_object_and_gitignore_gap(tree):
         check=True,
         capture_output=True,
     )
+    orphan = os.path.join(tree, NATIVE_REL, "eg_ghost.o")
+    with open(orphan, "wb") as f:
+        f.write(b"\x7fELF")
     mutate(tree, ".gitignore", ".sanitize/\n", "")
     vs = run_pass(tree, "artifacts")
-    msgs = "\n".join(v.message for v in vs)
+    msgs = "\n".join(f"{v.path}: {v.message}" for v in vs)
     assert any(v.rule == "artifact-hygiene" for v in vs)
-    assert "eg_epoch.o" in msgs  # tracked artifact + orphan object
+    assert "eg_epoch.o" in msgs  # tracked artifact
+    assert "eg_ghost.o" in msgs  # orphan object (no matching .cc)
     assert ".sanitize/" in msgs  # .gitignore gap
+    # the source-present object must NOT be called an orphan any more
+    epoch_msgs = [
+        v.message for v in vs
+        if "eg_epoch.o" in v.path or "eg_epoch.o" in v.message
+    ]
+    assert epoch_msgs and not any("orphan" in m for m in epoch_msgs), (
+        epoch_msgs
+    )
 
 
 def test_stale_contract_escape_is_flagged(tree):

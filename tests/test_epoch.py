@@ -1,0 +1,428 @@
+"""Snapshot epochs (_native/eg_epoch): delta loads, coordinated flips,
+and generation-keyed invalidation — the mutable-graph contract.
+
+Three properties pinned here, each the exit criterion of one leg of the
+rolling-refresh runbook (DEPLOY.md "Rolling graph refresh"):
+
+1. Whole-step snapshot consistency: an async sampling op PINS the epoch
+   current at submit, so a flip that lands mid-continuation (the
+   sampler_depth=2 ring keeps two steps in flight) must NOT leak new-
+   snapshot rows into an old step — pre-flip submits return pre-flip
+   rows bit-for-bit even when taken after the flip (kEpochKeep=2 holds
+   the superseded snapshot until its pins drain).
+
+2. Exact counter arithmetic per failpoint: `delta_load` (fires before
+   the file is read) and `epoch_flip` (fires after the merged engine is
+   built, exercising the staged-delta rollback) each count exactly one
+   `delta_loads_failed`, leave the serving epoch untouched, and leave
+   the shard able to apply the SAME delta afterwards — a refused load
+   must stage nothing.
+
+3. Delta hygiene: contradictory or duplicate edits are refused LOUDLY
+   at both layers — convert.make_delta (duplicate node/edge records in
+   an input) and the native DeltaFile::Validate (remove+re-emit of one
+   key, duplicate removed ids, non-monotonic seq), each leaving the
+   serving snapshot at its old epoch.
+
+Plus the local closure property the whole design rests on: a flipped
+snapshot is bit-identical to a fresh load of base + the same delta
+chain (`Graph(directory=..., delta=...)`).
+"""
+
+import copy
+import os
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import native
+from euler_tpu.graph.convert import make_delta, pack_block, pack_delta
+from euler_tpu.graph.graph import Graph
+from euler_tpu.graph.service import GraphService
+from tests.fixture_graph import FIXTURE_META, fixture_nodes, write_fixture
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Failpoints and counters are process-global; no test may leak."""
+    native.fault_clear()
+    native.reset_counters()
+    yield
+    native.fault_clear()
+    native.reset_counters()
+
+
+# ---------------------------------------------------------------------------
+# delta builders (diff the fixture against a mutated copy)
+# ---------------------------------------------------------------------------
+
+
+def _retarget_14(nodes):
+    """14's single type-0 edge moves 15 -> 16: the one mutation the
+    deterministic-parity tests lean on (one candidate before AND after,
+    so no RNG is consulted and bit-parity is defined)."""
+    n14 = next(n for n in nodes if n["node_id"] == 14)
+    n14["neighbor"]["0"] = {"16": 2.0}
+    for e in n14["edge"]:
+        if e["edge_type"] == 0 and e["dst_id"] == 15:
+            e["dst_id"] = 16
+            e["uint64_feature"] = {"0": [14 * 100 + 16]}
+            e["binary_feature"] = {"0": "e14-16"}
+    return nodes
+
+
+def _minimal_new_nodes():
+    return _retarget_14([copy.deepcopy(n) for n in fixture_nodes()])
+
+
+def _rich_new_nodes():
+    """The full mutation menu in one delta: node removal (15), feature
+    + weight change (10), edge removal (12-1->13), edge retarget (14),
+    node addition (17)."""
+    nodes = {n["node_id"]: copy.deepcopy(n) for n in fixture_nodes()}
+    del nodes[15]
+    nodes[10]["node_weight"] = 9.0
+    nodes[10]["float_feature"]["0"] = [123.5, 7.25]
+    n12 = nodes[12]
+    n12["neighbor"]["1"].pop("13")
+    n12["edge"] = [
+        e for e in n12["edge"]
+        if not (e["dst_id"] == 13 and e["edge_type"] == 1)
+    ]
+    _retarget_14(list(nodes.values()))
+    nodes[17] = {
+        "node_id": 17,
+        "node_type": 1,
+        "node_weight": 1.5,
+        "neighbor": {"0": {"10": 3.0}},
+        "uint64_feature": {"0": [17, 18], "1": [7]},
+        "float_feature": {
+            "0": [8.5, 4.25],
+            "1": [1.0, 2.0, 3.0],
+            "2": [0.0, 0.0, 0.0],
+        },
+        "binary_feature": {"0": "n17"},
+        "edge": [{
+            "src_id": 17, "dst_id": 10, "edge_type": 0, "weight": 3.0,
+            "uint64_feature": {"0": [17 * 100 + 10]},
+            "float_feature": {"0": [0.3]},
+            "binary_feature": {"0": "e17-10"},
+        }],
+    }
+    return list(nodes.values())
+
+
+def _write_delta(path, new_nodes, seq=1):
+    rm_n, rm_e, blob = make_delta(fixture_nodes(), new_nodes, FIXTURE_META)
+    with open(path, "wb") as f:
+        f.write(pack_delta(seq, rm_n, rm_e, blob))
+    return path
+
+
+def _one_shard(tmp_path):
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=2)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+    svc = GraphService(data, 0, 1, registry=reg)
+    g = Graph(mode="remote", registry=reg)
+    return svc, g
+
+
+# ---------------------------------------------------------------------------
+# local closure: a flip is bit-identical to a fresh merged load
+# ---------------------------------------------------------------------------
+
+
+def test_local_flip_bit_identical_to_fresh_merged_load(tmp_path):
+    data = str(tmp_path / "g")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=2)
+    dpath = _write_delta(str(tmp_path / "part.delta.1"), _rich_new_nodes())
+
+    g = Graph(directory=data)
+    fresh = None
+    try:
+        assert g.epoch() == 0
+        nbr, _, _, cnt = g.get_full_neighbor(
+            np.array([14], dtype=np.int64), [0]
+        )
+        assert list(np.asarray(nbr)[: int(cnt[0])]) == [15]
+
+        assert g.load_delta(dpath) == 1
+        assert g.epoch() == 1
+
+        # every mutation landed
+        ids = np.arange(10, 18, dtype=np.int64)
+        types = g.node_types(ids)
+        assert int(types[ids.tolist().index(15)]) == -1      # removed
+        assert int(types[ids.tolist().index(17)]) == 1       # added
+        np.testing.assert_allclose(
+            g.get_dense_feature(np.array([10], dtype=np.int64), [0], [2])[0],
+            [123.5, 7.25],
+        )
+        nbr, w, _, cnt = g.get_full_neighbor(
+            np.array([14], dtype=np.int64), [0]
+        )
+        assert list(np.asarray(nbr)[: int(cnt[0])]) == [16]  # retargeted
+        assert float(np.asarray(w)[0]) == 2.0
+        nbr, _, _, cnt = g.get_full_neighbor(
+            np.array([12], dtype=np.int64), [1]
+        )
+        assert list(np.asarray(nbr)[: int(cnt[0])]) == [14]  # (12,13,1) gone
+
+        # the closure: flipped == fresh merged load, bit for bit
+        fresh = Graph(directory=data, delta=dpath)
+        assert fresh.epoch() == 1
+        np.testing.assert_array_equal(g.node_types(ids),
+                                      fresh.node_types(ids))
+        np.testing.assert_array_equal(
+            g.get_dense_feature(ids, [0], [2]),
+            fresh.get_dense_feature(ids, [0], [2]),
+        )
+        for et in ([0], [1], [0, 1]):
+            a = g.get_full_neighbor(ids, et)
+            b = fresh.get_full_neighbor(ids, et)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    finally:
+        if fresh is not None:
+            fresh.close()
+        g.close()
+
+
+# ---------------------------------------------------------------------------
+# whole-step consistency: flip mid-flight under the depth-2 async ring
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_flip_under_depth2_async_is_whole_step_consistent(tmp_path):
+    from euler_tpu.parallel import pipeline
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=2)
+    reg = str(tmp_path / "reg")
+    os.makedirs(reg)
+    dpath = _write_delta(str(tmp_path / "part.delta.1"),
+                         _minimal_new_nodes())
+    services = [GraphService(data, s, 2, registry=reg) for s in range(2)]
+    remote = Graph(mode="remote", registry=reg)
+    try:
+        # forced rows only: 11 -0-> {12}, 13 -0-> {10}, 14 -0-> {15 pre /
+        # 16 post}, 15 has none — the strongest parity the server-side
+        # RNG permits (test_async_parity's deterministic-slice trick)
+        ids = np.array([11, 13, 14, 15], dtype=np.int64)
+        fan = 4
+        pre = np.repeat(
+            np.array([12, 10, 15, -1], dtype=np.int64), fan
+        ).reshape(len(ids), fan)
+        post = np.repeat(
+            np.array([12, 10, 16, -1], dtype=np.int64), fan
+        ).reshape(len(ids), fan)
+
+        steps, flip_at = 8, 3
+        flipped = [False]
+        expect = {}
+
+        def start_fn(step):
+            h = remote.sample_fanout_async(ids, [[0]], [fan],
+                                           default_node=-1)
+            assert h is not None
+            # the epoch pinned at SUBMIT decides the step's rows
+            expect[step] = post if flipped[0] else pre
+            if step == flip_at:
+                # flip both shards while this step (and, at depth 2,
+                # the previous one) is still in flight
+                for s in range(2):
+                    assert remote.load_delta(dpath, shard=s) == 1
+                flipped[0] = True
+            return h
+
+        def finish_fn(step, h):
+            a_ids, _, _ = h.take()
+            got = np.asarray(a_ids[1]).reshape(len(ids), fan)
+            np.testing.assert_array_equal(
+                got, expect[step],
+                err_msg=f"step {step} leaked rows across the flip",
+            )
+            return got
+
+        for _ in pipeline(start_fn, finish_fn, steps, depth=2):
+            pass
+        assert flipped[0] and len(expect) == steps
+
+        # the client learned the flip passively from v4 reply stamps
+        assert remote.shard_epoch(0) == 1
+        assert remote.shard_epoch(1) == 1
+        assert remote.epoch() == 1
+        assert remote.cache_gen >= 1
+
+        # ledger: one flip per shard, and every retired epoch drains
+        # once its pins release (poke with a sync sample while polling)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            ctr = native.counters()
+            if ctr["epoch_drains"] == ctr["epoch_flips"] == 2:
+                break
+            remote.sample_neighbor(ids, [0], fan, default_node=-1)
+            time.sleep(0.05)
+        ctr = native.counters()
+        assert ctr["epoch_flips"] == 2, ctr
+        assert ctr["epoch_drains"] == 2, ctr
+        assert ctr["delta_loads_failed"] == 0, ctr
+    finally:
+        remote.close()
+        for s in services:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# failpoints: exact counter arithmetic, nothing staged on refusal
+# ---------------------------------------------------------------------------
+
+
+def test_delta_load_failpoint_counts_exactly_one_refusal(tmp_path):
+    svc, g = _one_shard(tmp_path)
+    try:
+        dpath = _write_delta(str(tmp_path / "part.delta.1"),
+                             _minimal_new_nodes())
+        native.fault_config("delta_load:err@1.0#1", 7)
+        with pytest.raises(RuntimeError):
+            g.load_delta(dpath, shard=0)
+        ctr = native.counters()
+        assert ctr["delta_loads_failed"] == 1, ctr
+        assert ctr["epoch_flips"] == 0 and ctr["epoch_drains"] == 0, ctr
+        assert native.fault_injected()["delta_load"] == 1
+        assert g.shard_epoch(0) == 0  # still serving the base snapshot
+
+        # limit #1 exhausted: the SAME delta now applies cleanly
+        assert g.load_delta(dpath, shard=0) == 1
+        ctr = native.counters()
+        assert ctr["delta_loads_failed"] == 1, ctr
+        assert ctr["epoch_flips"] == 1, ctr
+    finally:
+        g.close()
+        svc.stop()
+
+
+def test_epoch_flip_failpoint_rolls_back_staged_delta(tmp_path):
+    svc, g = _one_shard(tmp_path)
+    try:
+        dpath = _write_delta(str(tmp_path / "part.delta.1"),
+                             _minimal_new_nodes())
+        # fires AFTER the merged engine is built: the staged delta must
+        # roll back, or the retry below would refuse seq 1 as stale
+        native.fault_config("epoch_flip:err@1.0#1", 9)
+        with pytest.raises(RuntimeError):
+            g.load_delta(dpath, shard=0)
+        ctr = native.counters()
+        assert ctr["delta_loads_failed"] == 1, ctr
+        assert ctr["epoch_flips"] == 0, ctr
+        assert native.fault_injected()["epoch_flip"] == 1
+        assert g.shard_epoch(0) == 0
+        # old snapshot still serves: 14 -0-> 15, pre-delta
+        nbr, _, _ = g.sample_neighbor(
+            np.array([14], dtype=np.int64), [0], 2, default_node=-1
+        )
+        assert set(np.asarray(nbr).ravel()) == {15}
+
+        assert g.load_delta(dpath, shard=0) == 1  # rollback left seq free
+        ctr = native.counters()
+        assert ctr["delta_loads_failed"] == 1 and ctr["epoch_flips"] == 1
+        nbr, _, _ = g.sample_neighbor(
+            np.array([14], dtype=np.int64), [0], 2, default_node=-1
+        )
+        assert set(np.asarray(nbr).ravel()) == {16}
+    finally:
+        g.close()
+        svc.stop()
+
+
+def test_non_monotonic_seq_refused_and_counted(tmp_path):
+    svc, g = _one_shard(tmp_path)
+    try:
+        dpath = _write_delta(str(tmp_path / "part.delta.1"),
+                             _minimal_new_nodes())
+        assert g.load_delta(dpath, shard=0) == 1
+        with pytest.raises(RuntimeError):
+            g.load_delta(dpath, shard=0)  # seq 1 again: stale
+        ctr = native.counters()
+        assert ctr["delta_loads_failed"] == 1, ctr
+        assert ctr["epoch_flips"] == 1, ctr
+        assert g.shard_epoch(0) == 1
+    finally:
+        g.close()
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# delta hygiene: contradictory / duplicate edits refused loudly
+# ---------------------------------------------------------------------------
+
+
+def test_make_delta_rejects_duplicate_node_records():
+    new = [copy.deepcopy(n) for n in fixture_nodes()]
+    new.append(copy.deepcopy(new[0]))
+    with pytest.raises(ValueError, match="duplicate node_id"):
+        make_delta(fixture_nodes(), new, FIXTURE_META)
+
+
+def test_make_delta_rejects_duplicate_edge_records():
+    new = [copy.deepcopy(n) for n in fixture_nodes()]
+    n10 = next(n for n in new if n["node_id"] == 10)
+    n10["edge"].append(copy.deepcopy(n10["edge"][0]))
+    with pytest.raises(ValueError, match="duplicate edge record"):
+        make_delta(fixture_nodes(), new, FIXTURE_META)
+
+
+@pytest.mark.parametrize(
+    "payload, msg",
+    [
+        # remove edge (10,11,0) AND re-emit node 10 still carrying it
+        (
+            lambda: pack_delta(
+                1, [], [(10, 11, 0)],
+                pack_block(
+                    next(n for n in fixture_nodes()
+                         if n["node_id"] == 10),
+                    FIXTURE_META,
+                ),
+            ),
+            "both removed and re-emitted",
+        ),
+        # remove node 15 AND re-emit its record in the same delta
+        (
+            lambda: pack_delta(
+                1, [15], [],
+                pack_block(
+                    next(n for n in fixture_nodes()
+                         if n["node_id"] == 15),
+                    FIXTURE_META,
+                ),
+            ),
+            "both removed and present",
+        ),
+        (lambda: pack_delta(1, [15, 15], [], b""),
+         "duplicate removed node"),
+    ],
+)
+def test_native_validate_refuses_contradictory_delta(tmp_path, payload, msg):
+    data = str(tmp_path / "g")
+    os.makedirs(data)
+    write_fixture(data, num_partitions=2)
+    path = str(tmp_path / "part.delta.1")
+    with open(path, "wb") as f:
+        f.write(payload())
+    g = Graph(directory=data)
+    try:
+        with pytest.raises(RuntimeError, match=msg):
+            g.load_delta(path)
+        assert g.epoch() == 0  # refusal staged nothing
+        ctr = native.counters()
+        assert ctr["delta_loads_failed"] == 1, ctr
+        assert ctr["epoch_flips"] == 0, ctr
+    finally:
+        g.close()

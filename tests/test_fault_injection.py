@@ -55,10 +55,15 @@ COUNTER_NAMES = {
     # high-water mark of concurrently running ops, and hop/slice
     # continuations re-enqueued by job completions
     "async_submits", "async_inflight_peak", "async_continuations",
+    # snapshot-epoch ledger (PR 19): delta flips, retired-epoch drains,
+    # stale cache generations evicted on touch, and refused delta loads
+    "epoch_flips", "epoch_drains", "epoch_stale_hits_evicted",
+    "delta_loads_failed",
 }
 FAULT_NAMES = {
     "dial", "send_frame", "recv_frame", "service_reply", "registry_reply",
     "heartbeat", "accept", "handler_stall", "busy_force", "crash",
+    "delta_load", "epoch_flip",
 }
 
 

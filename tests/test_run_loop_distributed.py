@@ -114,6 +114,17 @@ def test_run_loop_two_process_matches_single(planted_dir, tmp_path):
     try:
         for pid, p in enumerate(procs):
             out, err = p.communicate(timeout=420)
+            if (
+                p.returncode != 0
+                and "Multiprocess computations aren't implemented" in err
+            ):
+                # environment limit, not a code regression: this
+                # jaxlib's CPU backend has no cross-process collectives
+                # (same guard as conftest.run_worker_processes)
+                pytest.skip(
+                    "CPU backend lacks multiprocess computations "
+                    "(jax.distributed collectives unavailable)"
+                )
             assert p.returncode == 0, (
                 f"worker {pid} failed:\n{err[-2500:]}"
             )

@@ -42,7 +42,7 @@ def only_rule(violations, rule):
 
 def test_native_tree_is_clean():
     files = check_native.default_targets(str(REPO))
-    assert len(files) >= 39, files  # all .cc and .h of _native
+    assert len(files) >= 40, files  # all .cc and .h of _native
     # the fault layer, the remote hot-path additions (persistent
     # dispatcher + feature cache), the server survivability layer
     # (bounded admission), the telemetry subsystem, the step-phase
@@ -58,6 +58,7 @@ def test_native_tree_is_clean():
         "eg_blackbox.cc", "eg_blackbox.h", "eg_heat.cc", "eg_heat.h",
         "eg_placement.cc", "eg_placement.h",
         "eg_devprof.cc", "eg_devprof.h", "eg_async.h",
+        "eg_epoch.cc", "eg_epoch.h",
     } <= names, names
     violations = []
     for f in files:
@@ -682,6 +683,88 @@ def test_thread_catch_fires_on_async_drain_thread_shape():
         "}\n"
     )
     (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
+
+
+# ---------------------------------------------------------------------------
+# snapshot-epoch shapes: the eg_epoch engine (delta loads + RCU flips)
+# stays under the gate — the flip publish and the delta reader are the
+# two places a crash-class slip corrupts a SERVING snapshot in place
+# ---------------------------------------------------------------------------
+
+
+def test_abi_barrier_fires_on_load_deltas_shape():
+    """eg_load_deltas is called from Graph.load_delta on the training
+    thread — a guardless entry point would carry a parse/merge
+    exception (bad delta file, bad_alloc on a huge blob) straight
+    across ctypes as std::terminate instead of an error string."""
+    snippet = (
+        'extern "C" {\n'
+        "int eg_load_deltas(void* h, const char* paths) {\n"
+        "  return eg::LoadEngineWithDeltas(h, paths) ? 0 : -1;\n"
+        "}\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "abi-barrier")
+    assert "eg_load_deltas" in v.message
+
+
+def test_raw_lock_fires_on_epoch_flip_publish_shape():
+    """The flip publish (loader thread) and Pin (every handler thread)
+    meet on epoch_mu_ — a raw lock there leaks the mutex on any early
+    return and wedges every reader behind a half-published epoch."""
+    snippet = (
+        "uint64_t Flip(std::shared_ptr<Engine> next) {\n"
+        "  epoch_mu_.lock();\n"
+        "  snaps_.push_back(std::move(next));\n"
+        "  epoch_mu_.unlock();\n"
+        "  return snaps_.back()->epoch;\n"
+        "}\n"
+    )
+    violations = only_rule(lint(snippet), "raw-lock")
+    assert [v.line for v in violations] == [2, 4]
+
+
+def test_wire_count_alloc_fires_on_delta_reader_shape():
+    """The delta-file reader allocates arrays sized by counts read
+    from the file — an unchecked count is the bad_alloc/OOM class the
+    EGD1 parser must divide-guard exactly like the wire decoders."""
+    snippet = (
+        "bool ReadArr(WireReader* r, std::vector<uint64_t>* out) {\n"
+        "  int64_t n = r->I64();\n"
+        "  out->resize(n);\n"
+        "  return true;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "wire-count-alloc")
+    assert v.line == 3
+
+
+def test_thread_catch_fires_on_delta_loader_thread_shape():
+    """A background delta-loader thread (prefetching the next delta's
+    merge off the handler path) is a service thread: its entry lambda
+    needs a top-level catch, or one malformed delta file takes down
+    the serving shard instead of counting delta_loads_failed."""
+    snippet = (
+        "void StartLoader() {\n"
+        "  std::thread([this] { LoadPendingDelta(); }).detach();\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "thread-catch")
+    assert v.line == 2
+
+
+def test_ptr_arith_bounds_fires_on_delta_apply_shape():
+    """Applying a delta walks the packed dat_blob with counted records
+    — the overflow-prone `p + n * size > end` bounds form is exactly
+    the round-2 crash class; the divide form is the fix."""
+    snippet = (
+        "bool ApplyRecords(const char* p, const char* end, size_t n) {\n"
+        "  if (p + n * sizeof(uint64_t) > end) return false;\n"
+        "  return true;\n"
+        "}\n"
+    )
+    (v,) = only_rule(lint(snippet), "ptr-arith-bounds")
     assert v.line == 2
 
 
